@@ -1,0 +1,77 @@
+"""Headline benchmark: ResNet-18 448x448 train-step throughput per chip.
+
+Mirrors the reference's run-of-record config (ResNet-18, 448x448,
+per-rank batch 128, SGD momentum 0.9 wd 1e-4 — BASELINE.md): the
+reference sustained 152.8 img/s/GPU on its 16-GPU cluster (derived from
+`imagent_sgd.out:14,278`). This measures the same per-chip quantity for
+the jitted SPMD train step on the local device(s), synthetic device-resident
+data (input pipeline excluded on both sides: the reference number is also
+compute-dominated at 10 workers/rank).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S_PER_CHIP = 152.8  # reference img/s/GPU (BASELINE.md)
+
+
+def main() -> int:
+    import jax
+
+    from imagent_tpu.cluster import make_mesh
+    from imagent_tpu.models import create_model
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step,
+        replicate_state, shard_batch,
+    )
+
+    n_chips = len(jax.devices())
+    per_chip_batch = 128  # reference per-rank batch (imagenet.py:443)
+    batch = per_chip_batch * n_chips
+    size = 448
+
+    mesh = make_mesh(model_parallel=1)
+    model = create_model("resnet18", num_classes=1000, bf16=True)
+    opt = make_optimizer()
+    state = replicate_state(
+        create_train_state(model, jax.random.key(0), size, opt,
+                           batch_size=2), mesh)
+    step = make_train_step(model, opt, mesh)
+
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(batch, size, size, 3)).astype(np.float32)
+    labels = rng.integers(0, 1000, size=(batch,)).astype(np.int32)
+    gi, gl = shard_batch(mesh, images, labels)
+    lr = np.float32(0.1)
+
+    # Warmup / compile. np.asarray is a hard device->host fetch: on the
+    # experimental axon platform block_until_ready alone returns early.
+    for _ in range(3):
+        state, metrics = step(state, gi, gl, lr)
+    np.asarray(metrics)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, gi, gl, lr)
+    np.asarray(metrics)  # sync: last step depends on the whole chain
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    img_s_chip = img_s / n_chips
+    print(json.dumps({
+        "metric": "resnet18_448_train_throughput_per_chip",
+        "value": round(img_s_chip, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_s_chip / BASELINE_IMG_S_PER_CHIP, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

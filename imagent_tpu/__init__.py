@@ -1,0 +1,26 @@
+"""imagent_tpu — a TPU-native distributed ImageNet training framework.
+
+A ground-up JAX/XLA re-design of the capability surface of
+``Abdoulaye-Koroko/Imagent-distributed-training-pytorch-with-slurm``
+(reference mounted at ``/root/reference``): Slurm-launched multi-host
+synchronous data-parallel ImageNet classification with collective
+gradient reduction, distributed data sharding, cross-rank metric
+reduction, LR scheduling, TensorBoard logging and best-model
+checkpointing (reference: ``imagenet.py:1-453``, ``imagenet.sh:1-27``).
+
+TPU-first architecture:
+
+* SPMD over a ``jax.sharding.Mesh`` (``data`` x ``model`` axes) instead of
+  one-process-per-GPU DDP (``imagenet.py:316``).
+* One jit-compiled train step: forward, loss, grad, ``psum``-mean of
+  gradients *and* metrics — collapsing the reference's per-step
+  3 scalar allreduces + device sync (``imagenet.py:137-148``).
+* ``jax.distributed.initialize()`` (PJRT coordination service) instead of
+  the ``env://`` TCP rendezvous (``imagenet.py:237-273``).
+* Per-host sharded input pipeline instead of ``DistributedSampler``
+  (``imagenet.py:346-359``).
+"""
+
+__version__ = "0.1.0"
+
+from imagent_tpu.config import Config, parse_args  # noqa: F401

@@ -1,0 +1,24 @@
+"""CLI entry point: ``python -m imagent_tpu [flags]``.
+
+The reference's ``__main__`` block (``imagenet.py:433-452``) — argparse →
+``run(args)`` — with the same flag surface plus the promoted constants
+(see ``config.py``).
+"""
+
+import os
+import sys
+
+from imagent_tpu.config import parse_args
+
+
+def main(argv=None) -> int:
+    cfg = parse_args(argv)
+    if cfg.backend:
+        os.environ.setdefault("JAX_PLATFORMS", cfg.backend)
+    from imagent_tpu.engine import run  # import after platform selection
+    run(cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

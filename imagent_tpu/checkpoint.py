@@ -1,0 +1,60 @@
+"""Checkpointing via Orbax.
+
+Parity behavior: best-model-on-improvement, written by process 0 only when
+``--save-model`` is passed (``imagenet.py:388-392``). The reference saves
+ONLY ``model.state_dict()`` — no optimizer state, no epoch counter, and no
+resume path at all (SURVEY §5 "Checkpoint / resume"). This module closes
+that gap: the full ``{params, batch_stats, opt_state, step}`` bundle plus
+``{epoch, best_top1, best_top5}`` metadata round-trips, enabling
+``--resume`` after preemption (which matters far more on TPU pods).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from imagent_tpu.train import TrainState
+
+BEST = "best"
+LAST = "last"
+
+
+def _meta_path(ckpt_dir: str, name: str) -> str:
+    return os.path.join(ckpt_dir, f"{name}_meta.json")
+
+
+def save(ckpt_dir: str, name: str, state: TrainState, meta: dict) -> None:
+    """Write checkpoint + sidecar metadata. Multi-host safe: Orbax
+    coordinates across processes; the JSON sidecar is process-0 only."""
+    path = os.path.abspath(os.path.join(ckpt_dir, name))
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, jax.device_get(state), force=True)
+    ckptr.wait_until_finished()
+    if jax.process_index() == 0:
+        with open(_meta_path(ckpt_dir, name), "w") as f:
+            json.dump(meta, f)
+
+
+def restore(ckpt_dir: str, name: str,
+            target: TrainState) -> tuple[TrainState, dict] | None:
+    """Restore (state, meta) or None if absent. ``target`` supplies the
+    tree structure/shapes (an abstract or concrete TrainState)."""
+    path = os.path.abspath(os.path.join(ckpt_dir, name))
+    if not os.path.isdir(path):
+        return None
+    ckptr = ocp.StandardCheckpointer()
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), target)
+    state = ckptr.restore(path, abstract)
+    meta: dict[str, Any] = {}
+    mp = _meta_path(ckpt_dir, name)
+    if os.path.exists(mp):
+        with open(mp) as f:
+            meta = json.load(f)
+    return state, meta

@@ -1,0 +1,120 @@
+"""Configuration: CLI flags + typed dataclass.
+
+Keeps the reference's argparse surface (``imagenet.py:433-452``):
+``--seed --backend --batch-size --epochs --lr --save-model``, and promotes
+its hard-coded constants to flags with reference defaults (image size 448
+at ``imagenet.py:281``, normalize constants ``imagenet.py:283``, data root
+``imagenet.py:287-289``, momentum/weight-decay ``imagenet.py:325``, LR step
+decay /10 every 30 epochs ``imagenet.py:154-162``, workers ``imagenet.py:352``,
+TensorBoard dir / checkpoint path ``imagenet.py:363,392``, arch
+``imagenet.py:312``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Sequence
+
+
+@dataclasses.dataclass
+class Config:
+    # ---- reference flag surface (imagenet.py:435-450) ----
+    seed: int = 0
+    backend: str = "tpu"  # PJRT platform: tpu|cpu|gpu (reference: nccl|gloo)
+    batch_size: int = 128  # per data-parallel replica, as in the reference
+    epochs: int = 100
+    lr: float = 0.1
+    save_model: bool = False
+
+    # ---- promoted hard-coded constants (reference defaults) ----
+    arch: str = "resnet18"  # imagenet.py:312
+    image_size: int = 448  # imagenet.py:281
+    num_classes: int = 1000
+    mean: Sequence[float] = (0.5, 0.5, 0.5)  # imagenet.py:283
+    std: Sequence[float] = (0.5, 0.5, 0.5)  # imagenet.py:283
+    data_root: str = "../data/imagenet"  # imagenet.py:287-289
+    momentum: float = 0.9  # imagenet.py:325
+    weight_decay: float = 1e-4  # imagenet.py:325
+    lr_decay_period: int = 30  # imagenet.py:158
+    lr_decay_factor: float = 0.1  # imagenet.py:158
+    workers: int = 10  # imagenet.py:352
+    log_dir: str = "runs/imagent_tpu"  # imagenet.py:363
+    ckpt_dir: str = "checkpoints"  # imagenet.py:392 (file → dir for Orbax)
+
+    # ---- new capabilities (absent in reference) ----
+    resume: bool = False  # full-state resume (reference has none, SURVEY §5)
+    dataset: str = "imagefolder"  # imagefolder | synthetic
+    synthetic_size: int = 2048  # images per epoch in synthetic mode
+    bf16: bool = True  # bfloat16 compute on the MXU
+    warmup_epochs: int = 0  # linear LR warmup (0 = reference behavior)
+    schedule: str = "step"  # step | cosine
+    eval_every: int = 1  # validate every N epochs
+    log_every: int = 50  # step-level stdout cadence on process 0
+    profile: bool = False  # opt-in jax.profiler trace (SURVEY §5 tracing)
+    check_nans: bool = False  # debug flag (SURVEY §5 sanitizers)
+
+    # ---- mesh geometry ----
+    # Data-parallel size is inferred (devices / model_parallel). A model axis
+    # is first-class in the mesh design (SURVEY §2c disposition) even though
+    # the parity workload only uses the data axis.
+    model_parallel: int = 1
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="TPU-native distributed ImageNet training (imagent_tpu)"
+    )
+    c = Config()
+    # Reference flag names kept verbatim (imagenet.py:435-450).
+    p.add_argument("--seed", type=int, default=c.seed, help="random seed")
+    p.add_argument("--backend", type=str, default=c.backend,
+                   help="PJRT platform: tpu|cpu|gpu")
+    p.add_argument("--batch-size", type=int, default=c.batch_size,
+                   help="per-replica batch size (default: 128)")
+    p.add_argument("--epochs", type=int, default=c.epochs,
+                   help="number of epochs to train (default: 100)")
+    p.add_argument("--lr", type=float, default=c.lr,
+                   help="initial learning rate (default: 0.1)")
+    p.add_argument("--save-model", action="store_true", default=False,
+                   help="save best checkpoint on val top-1 improvement")
+    # Promoted constants.
+    p.add_argument("--arch", type=str, default=c.arch,
+                   choices=["resnet18", "resnet34", "resnet50",
+                            "resnet101", "resnet152", "vit_b16", "vit_l16"])
+    p.add_argument("--image-size", type=int, default=c.image_size)
+    p.add_argument("--num-classes", type=int, default=c.num_classes)
+    p.add_argument("--data-root", type=str, default=c.data_root)
+    p.add_argument("--momentum", type=float, default=c.momentum)
+    p.add_argument("--weight-decay", type=float, default=c.weight_decay)
+    p.add_argument("--lr-decay-period", type=int, default=c.lr_decay_period)
+    p.add_argument("--lr-decay-factor", type=float, default=c.lr_decay_factor)
+    p.add_argument("--workers", type=int, default=c.workers)
+    p.add_argument("--log-dir", type=str, default=c.log_dir)
+    p.add_argument("--ckpt-dir", type=str, default=c.ckpt_dir)
+    # New capabilities.
+    p.add_argument("--resume", action="store_true", default=False)
+    p.add_argument("--dataset", type=str, default=c.dataset,
+                   choices=["imagefolder", "synthetic"])
+    p.add_argument("--synthetic-size", type=int, default=c.synthetic_size)
+    p.add_argument("--no-bf16", dest="bf16", action="store_false",
+                   default=True)
+    p.add_argument("--warmup-epochs", type=int, default=c.warmup_epochs)
+    p.add_argument("--schedule", type=str, default=c.schedule,
+                   choices=["step", "cosine"])
+    p.add_argument("--eval-every", type=int, default=c.eval_every)
+    p.add_argument("--log-every", type=int, default=c.log_every)
+    p.add_argument("--profile", action="store_true", default=False)
+    p.add_argument("--check-nans", action="store_true", default=False)
+    p.add_argument("--model-parallel", type=int, default=c.model_parallel)
+    return p
+
+
+def parse_args(argv: Sequence[str] | None = None) -> Config:
+    ns = build_parser().parse_args(argv)
+    fields = {f.name for f in dataclasses.fields(Config)}
+    kw = {k: v for k, v in vars(ns).items() if k in fields}
+    return Config(**kw)

@@ -1,0 +1,1 @@
+from imagent_tpu.data.pipeline import Batch, make_loaders  # noqa: F401

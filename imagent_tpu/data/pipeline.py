@@ -1,0 +1,113 @@
+"""Input pipeline contract + factory.
+
+TPU-native replacement for the reference's data layer
+(``imagenet.py:278-359``): ``datasets.ImageNet`` + ``DistributedSampler``
++ 10-worker pinned-memory ``DataLoader`` become per-host sharded loaders
+that yield host-local numpy batches; ``train.shard_batch`` assembles them
+into global device arrays over the mesh.
+
+Sharding/shuffle semantics (``DistributedSampler``, ``imagenet.py:346-347``):
+
+* every epoch, a permutation of the dataset seeded by ``seed + epoch``
+  (the ``sampler.set_epoch`` contract, ``imagenet.py:375``);
+* process ``p`` of ``P`` takes rows ``p::P`` of the permutation;
+* train drops the global remainder (DistributedSampler pads/duplicates;
+  dropping keeps every step's global batch full — same steps/epoch when
+  divisible, as in the run of record: 1,281,167 → 625 full steps at 2048);
+* eval keeps ALL samples: the tail batch is padded and a validity mask
+  marks padding, so metrics are exact on any chip count — fixing the
+  reference's divisibility assumption (``imagenet.py:355-359``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Protocol
+
+import numpy as np
+
+from imagent_tpu.config import Config
+
+
+@dataclasses.dataclass
+class Batch:
+    """Host-local shard of one global batch (NHWC float32, int32, float32)."""
+
+    images: np.ndarray
+    labels: np.ndarray
+    mask: np.ndarray  # 1.0 = real sample, 0.0 = eval padding
+
+
+class Loader(Protocol):
+    steps_per_epoch: int
+    num_examples: int
+
+    def epoch(self, epoch: int) -> Iterator[Batch]: ...
+
+
+PAD_ROW = -1  # sentinel: padded slot, contributes mask 0.0
+
+
+def shard_indices(n: int, epoch: int, seed: int, process_index: int,
+                  process_count: int, shuffle: bool,
+                  drop_remainder: bool, global_batch: int) -> np.ndarray:
+    """Pure sharding logic (unit-testable): which dataset rows this host
+    reads this epoch. Mirrors ``DistributedSampler`` + ``set_epoch``.
+
+    Every process receives the SAME number of slots (SPMD requirement:
+    unequal per-host batch counts would deadlock the collective in the
+    eval step — the invariant DistributedSampler keeps by padding).
+    Train drops the global remainder; eval pads with ``PAD_ROW`` sentinels
+    which become masked samples.
+    """
+    order = (np.random.default_rng(seed + epoch).permutation(n)
+             if shuffle else np.arange(n))
+    if drop_remainder:
+        usable = (n // global_batch) * global_batch
+        order = order[:usable]
+    else:
+        padded = -(-n // global_batch) * global_batch
+        order = np.concatenate(
+            [order, np.full(padded - n, PAD_ROW, np.int64)])
+    return order[process_index::process_count]
+
+
+def iter_batch_rows(idx: np.ndarray, local_rows: int):
+    """Split a host's slot array into per-batch row arrays. With
+    ``shard_indices`` output, every host yields the same batch count."""
+    for start in range(0, len(idx), local_rows):
+        rows = idx[start:start + local_rows]
+        if len(rows) == local_rows:
+            yield rows
+
+
+def pad_batch(images: np.ndarray, labels: np.ndarray,
+              rows: int) -> Batch:
+    """Pad a short (eval tail) batch up to ``rows`` with masked samples."""
+    k = images.shape[0]
+    mask = np.zeros((rows,), np.float32)
+    mask[:k] = 1.0
+    if k < rows:
+        pad_img = np.zeros((rows - k,) + images.shape[1:], images.dtype)
+        pad_lbl = np.zeros((rows - k,), labels.dtype)
+        images = np.concatenate([images, pad_img], 0)
+        labels = np.concatenate([labels, pad_lbl], 0)
+    return Batch(images=images, labels=labels, mask=mask)
+
+
+def make_loaders(cfg: Config, process_index: int, process_count: int,
+                 global_batch: int) -> tuple["Loader", "Loader"]:
+    """Build (train_loader, val_loader) per ``cfg.dataset``."""
+    if cfg.dataset == "synthetic":
+        from imagent_tpu.data.synthetic import SyntheticLoader
+        train = SyntheticLoader(cfg, process_index, process_count,
+                                global_batch, train=True)
+        val = SyntheticLoader(cfg, process_index, process_count,
+                              global_batch, train=False)
+        return train, val
+    from imagent_tpu.data.imagefolder import ImageFolderLoader
+    train = ImageFolderLoader(cfg, process_index, process_count,
+                              global_batch, split="train")
+    val = ImageFolderLoader(cfg, process_index, process_count,
+                            global_batch, split="val")
+    return train, val

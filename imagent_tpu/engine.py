@@ -1,0 +1,175 @@
+"""The orchestrator: init → data → model → epoch loop → summary.
+
+Re-designs the reference's ``run()`` (``imagenet.py:213-429``) as a
+TPU-native driver:
+
+* cluster init via ``cluster.initialize`` (replacing ``imagenet.py:237-273``);
+* global mesh; global-batch = per-replica batch × data-parallel size
+  (the reference's 128 × 16 = 2048 geometry);
+* epoch loop with epoch-seeded reshuffle (``set_epoch``,
+  ``imagenet.py:375``), per-epoch LR (``imagenet.py:378``), train +
+  validate (``imagenet.py:381-384``), best-top1 tracking + master-only
+  best checkpoint (``imagenet.py:388-396``), epoch prints + TensorBoard
+  scalars (``imagenet.py:397-421``), final summary (``imagenet.py:422-429``).
+
+Host-sync discipline (SURVEY §7): steps are dispatched asynchronously;
+per-step metric vectors are tiny replicated arrays accumulated on host
+at epoch end — the device never waits on Python between steps, unlike
+the reference's ``torch.cuda.synchronize()`` every step
+(``imagenet.py:147``).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from imagent_tpu import checkpoint as ckpt_lib
+from imagent_tpu import cluster
+from imagent_tpu.config import Config
+from imagent_tpu.data import make_loaders
+from imagent_tpu.models import create_model
+from imagent_tpu.schedule import lr_for_epoch
+from imagent_tpu.train import (
+    TrainState, create_train_state, make_eval_step, make_optimizer,
+    make_train_step, replicate_state, shard_batch,
+)
+from imagent_tpu.utils.logging import TrainLogger
+from imagent_tpu.utils.metrics import AverageMeter
+
+
+def _finalize(metric_buf: list) -> dict:
+    """Sum per-step [loss_sum, top1, top5, n] vectors → epoch averages.
+    One host sync per epoch (not per step)."""
+    if not metric_buf:
+        return {"loss": 0.0, "top1": 0.0, "top5": 0.0, "n": 0}
+    total = np.sum(np.stack([np.asarray(m) for m in metric_buf]), axis=0)
+    loss_sum, c1, c5, n = [float(x) for x in total]
+    n = max(n, 1.0)
+    return {"loss": loss_sum / n, "top1": c1 * 100.0 / n,
+            "top5": c5 * 100.0 / n, "n": int(n)}
+
+
+def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
+                    loader, epoch: int, lr: float,
+                    is_master: bool) -> tuple[TrainState, dict, float]:
+    """One training epoch (reference ``train()``, ``imagenet.py:97-151``)."""
+    t0 = time.time()
+    data_time = AverageMeter("data")
+    metric_buf = []
+    lr_arr = np.float32(lr)
+    t_fetch = time.time()
+    for step_i, batch in enumerate(loader.epoch(epoch)):
+        data_time.update(time.time() - t_fetch)
+        images, labels = shard_batch(mesh, batch.images, batch.labels)
+        state, metrics = train_step(state, images, labels, lr_arr)
+        metric_buf.append(metrics)
+        if is_master and cfg.log_every and (step_i + 1) % cfg.log_every == 0:
+            m = np.asarray(metrics)  # syncs a step already in flight
+            print(f"  epoch {epoch + 1} step {step_i + 1}/"
+                  f"{loader.steps_per_epoch} loss "
+                  f"{m[0] / max(m[3], 1):.4f} data_time {data_time.avg:.3f}s",
+                  flush=True)
+        t_fetch = time.time()
+    epoch_metrics = _finalize(metric_buf)  # the only mandatory sync point
+    return state, epoch_metrics, time.time() - t0
+
+
+def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
+             epoch: int) -> tuple[dict, float]:
+    """Validation epoch (reference ``validate()``, ``imagenet.py:166-210``),
+    exact under padding via the mask."""
+    t0 = time.time()
+    metric_buf = []
+    for batch in loader.epoch(epoch):
+        images, labels, mask = shard_batch(
+            mesh, batch.images, batch.labels, batch.mask)
+        metric_buf.append(eval_step(state, images, labels, mask))
+    return _finalize(metric_buf), time.time() - t0
+
+
+def run(cfg: Config) -> dict:
+    """Full training run. Returns the final summary dict."""
+    # cfg.backend selects the PJRT platform unless the environment already
+    # pinned one (cluster.initialize uses setdefault on JAX_PLATFORMS).
+    senv = cluster.initialize(cfg.backend or None)
+    print(cluster.rank_banner(senv), flush=True)
+    is_master = jax.process_index() == 0
+
+    mesh = cluster.make_mesh(cfg.model_parallel)
+    n_data = mesh.shape[cluster.DATA_AXIS]
+    global_batch = cfg.batch_size * n_data
+    if is_master:
+        print(f"mesh {dict(mesh.shape)} global_batch {global_batch}",
+              flush=True)
+
+    train_loader, val_loader = make_loaders(
+        cfg, jax.process_index(), jax.process_count(), global_batch)
+
+    model = create_model(cfg.arch, cfg.num_classes, cfg.bf16)
+    optimizer = make_optimizer(cfg.momentum, cfg.weight_decay)
+    # Same seed on every process ⇒ identical init, the DDP broadcast
+    # equivalence (imagenet.py:215,316).
+    state = create_train_state(
+        model, jax.random.key(cfg.seed), cfg.image_size, optimizer)
+    state = replicate_state(state, mesh)
+    train_step = make_train_step(model, optimizer, mesh)
+    eval_step = make_eval_step(model, mesh)
+
+    start_epoch, best_top1, best_top5, best_epoch = 0, 0.0, 0.0, -1
+    if cfg.resume:
+        restored = ckpt_lib.restore(cfg.ckpt_dir, ckpt_lib.LAST, state)
+        if restored is not None:
+            state, meta = restored
+            state = replicate_state(state, mesh)
+            start_epoch = int(meta.get("epoch", -1)) + 1
+            best_top1 = float(meta.get("best_top1", 0.0))
+            best_top5 = float(meta.get("best_top5", 0.0))
+            best_epoch = int(meta.get("best_epoch", -1))
+            if is_master:
+                print(f"resumed from epoch {start_epoch}", flush=True)
+
+    logger = TrainLogger(cfg.log_dir, is_master)
+    if cfg.check_nans:
+        jax.config.update("jax_debug_nans", True)
+    if cfg.profile and is_master:
+        jax.profiler.start_trace(cfg.log_dir)
+
+    run_t0 = time.time()
+    train_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
+    val_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
+    for epoch in range(start_epoch, cfg.epochs):
+        lr = lr_for_epoch(cfg, epoch)
+        state, train_m, train_t = train_one_epoch(
+            cfg, mesh, train_step, state, train_loader, epoch, lr, is_master)
+        did_eval = (epoch + 1) % cfg.eval_every == 0 or epoch == cfg.epochs - 1
+        if did_eval:
+            val_m, val_t = evaluate(cfg, mesh, eval_step, state,
+                                    val_loader, epoch)
+        else:
+            val_t = 0.0
+        if did_eval and val_m["top1"] > best_top1:
+            best_top1, best_top5, best_epoch = (
+                val_m["top1"], val_m["top5"], epoch)
+            if cfg.save_model:
+                ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.BEST, state, {
+                    "epoch": epoch, "best_top1": best_top1,
+                    "best_top5": best_top5, "best_epoch": best_epoch})
+        if cfg.save_model:
+            ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.LAST, state, {
+                "epoch": epoch, "best_top1": best_top1,
+                "best_top5": best_top5, "best_epoch": best_epoch})
+        logger.epoch_summary(epoch, lr, train_m,
+                             val_m if did_eval else None, train_t, val_t)
+        logger.scalars(epoch, lr, train_m, val_m if did_eval else None)
+
+    if cfg.profile and is_master:
+        jax.profiler.stop_trace()
+    total_min = (time.time() - run_t0) / 60.0
+    logger.final_summary(best_epoch, best_top1, best_top5, total_min)
+    logger.close()
+    return {"best_top1": best_top1, "best_top5": best_top5,
+            "best_epoch": best_epoch, "total_minutes": total_min,
+            "final_train": train_m, "final_val": val_m}

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Slurm launcher for TPU-VM clusters — the reference's imagenet.sh
+# (imagenet.sh:1-27) re-done for TPU pods.
+#
+# Differences from the reference (by design, not omission):
+#  * ONE task per host (JAX wants one process per TPU VM worker; the
+#    reference ran one per GPU, imagenet.sh:8-9).
+#  * NO NCCL env block — the reference's transport tuning
+#    (NCCL_P2P_DISABLE/NCCL_LL_THRESHOLD/NCCL_SOCKET_IFNAME/NCCL_IB_*,
+#    imagenet.sh:19-23) has no TPU analogue: XLA compiles collectives
+#    onto ICI and needs no per-job transport vars (SURVEY §5).
+#  * Rendezvous: imagent_tpu.cluster parses the same SLURM_* vars the
+#    reference did (imagenet.py:225-238) and feeds
+#    jax.distributed.initialize() instead of exporting MASTER_ADDR/PORT.
+#
+#SBATCH --job-name=imagent_tpu
+#SBATCH --partition=tpu
+#SBATCH --exclusive
+#SBATCH --nodes=8
+#SBATCH --ntasks=8
+#SBATCH --ntasks-per-node=1
+#SBATCH --cpus-per-task=96
+#SBATCH --hint=nomultithread
+#SBATCH --time=24:00:00
+#SBATCH --output=imagent_tpu_%j.out
+#SBATCH --error=imagent_tpu_%j.err
+
+cd "${SLURM_SUBMIT_DIR}"
+
+srun python -m imagent_tpu \
+  --backend=tpu \
+  --arch=resnet50 \
+  --batch-size=128 \
+  --epochs=90 \
+  --lr=0.1 \
+  --data-root=/data/imagenet \
+  --save-model "$@"

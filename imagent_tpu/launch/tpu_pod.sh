@@ -1,0 +1,18 @@
+#!/bin/bash
+# Direct TPU-pod launcher (no Slurm): run the same command on every
+# worker of a Cloud TPU pod slice. On TPU VMs, JAX discovers the pod
+# topology from the runtime — no coordinator flags needed
+# (jax.distributed.initialize() is auto-configured by the TPU metadata).
+#
+# Usage:
+#   bash tpu_pod.sh <tpu-name> <zone> [training flags...]
+#
+# This is the operator-ergonomics equivalent of "one sbatch, N ranks"
+# (imagenet.sh:26) for pods: one command fans out to all workers.
+
+set -euo pipefail
+TPU_NAME="$1"; shift
+ZONE="$1"; shift
+
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
+  --command "cd ~/imagent_tpu && python -m imagent_tpu --backend=tpu $*"

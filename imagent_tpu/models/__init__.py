@@ -1,0 +1,44 @@
+"""Model registry.
+
+The reference hard-codes ``models.resnet18`` (``imagenet.py:312``); here the
+arch is a flag (``--arch``) over the ResNet family required by the driver
+configs (resnet50/101/152) plus ViT backbones that exercise the attention /
+sequence-parallel machinery.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from imagent_tpu.models.resnet import (  # noqa: F401
+    PARAM_COUNTS, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
+)
+
+_REGISTRY = {
+    "resnet18": ResNet18,
+    "resnet34": ResNet34,
+    "resnet50": ResNet50,
+    "resnet101": ResNet101,
+    "resnet152": ResNet152,
+}
+
+
+def available_models() -> list[str]:
+    names = sorted(_REGISTRY)
+    try:  # ViT registers lazily to keep the core import light
+        from imagent_tpu.models import vit  # noqa: F401
+        names += sorted(vit.VIT_REGISTRY)
+    except ImportError:  # pragma: no cover
+        pass
+    return names
+
+
+def create_model(arch: str, num_classes: int = 1000, bf16: bool = False):
+    """Instantiate a model by name (the ``--arch`` flag)."""
+    dtype = jnp.bfloat16 if bf16 else jnp.float32
+    if arch.startswith("vit"):
+        from imagent_tpu.models import vit
+        return vit.create_vit(arch, num_classes=num_classes, dtype=dtype)
+    if arch not in _REGISTRY:
+        raise ValueError(f"unknown arch {arch!r}; one of {available_models()}")
+    return _REGISTRY[arch](num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,1 @@
+from imagent_tpu.ops.cross_entropy import softmax_cross_entropy  # noqa: F401

@@ -1,0 +1,31 @@
+"""Dot-product attention, written explicitly (einsum) rather than via a
+library black box, so parallel/sequence-parallel variants (ring attention
+over a mesh axis, Pallas-fused kernels) can swap in behind the same
+signature.
+
+No reference analogue — the reference is a CNN with no attention anywhere
+(SURVEY §2c); attention enters this framework with the ViT family and is
+the anchor for the long-context/sequence-parallel machinery.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Standard softmax attention.
+
+    Shapes: q/k/v ``(B, N, H, D)`` (batch, seq, heads, head_dim); returns
+    ``(B, N, H, D)``. Softmax statistics in fp32 regardless of input dtype
+    (bf16-safe on the MXU).
+    """
+    dtype = q.dtype
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    weights = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(dtype), v)

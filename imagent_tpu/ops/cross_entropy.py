@@ -1,0 +1,35 @@
+"""Softmax cross-entropy, the reference's loss (``nn.CrossEntropyLoss()``,
+``imagenet.py:323-324``).
+
+Computed from integer labels without materializing one-hots at the
+(batch, classes) matmul width: gather the target logit and subtract the
+log-sum-exp. XLA fuses the whole thing into the classifier epilogue, so
+there is no Pallas kernel here — the fusion already keeps it HBM-bound
+on the logits read only.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
+                          label_smoothing: float = 0.0) -> jnp.ndarray:
+    """Per-sample CE loss. ``logits`` (B, C) float, ``labels`` (B,) int.
+
+    Matches ``torch.nn.CrossEntropyLoss(reduction='none')`` semantics; the
+    mean over the batch is taken by the caller so that masked/padded eval
+    batches stay exact (SURVEY §7 "Eval sharding correctness").
+    """
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(
+        logits, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    nll = lse - target_logit
+    if label_smoothing > 0.0:
+        n = logits.shape[-1]
+        mean_logit = jnp.mean(logits, axis=-1)
+        smooth_nll = lse - mean_logit
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth_nll
+    return nll

@@ -1,0 +1,3 @@
+from imagent_tpu.parallel.collectives import (  # noqa: F401
+    pmean_tree, psum_tree,
+)

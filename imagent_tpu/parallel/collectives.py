@@ -1,0 +1,26 @@
+"""Collective helpers over mesh axes.
+
+The TPU-native replacement for the reference's NCCL usage (SURVEY §2b):
+DDP's bucketed gradient allreduce (``imagenet.py:316``, firing during
+``loss.backward()`` at ``:128``) and the explicit
+``dist.all_reduce(SUM)/world_size`` metric mean (``imagenet.py:82-87``)
+both become ``lax.psum``/``lax.pmean`` inside the jit-compiled step —
+XLA schedules them onto ICI and overlaps with compute, so there is no
+bucketing machinery to write.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def psum_tree(tree, axis_name: str):
+    """Sum every leaf across an axis (``dist.all_reduce(SUM)`` analogue)."""
+    return jax.tree.map(lambda x: lax.psum(x, axis_name), tree)
+
+
+def pmean_tree(tree, axis_name: str):
+    """Mean every leaf across an axis — DDP's gradient-averaging semantics
+    (allreduce-sum ÷ world_size, ``imagenet.py:85-86``)."""
+    return jax.tree.map(lambda x: lax.pmean(x, axis_name), tree)

@@ -1,0 +1,1 @@
+from imagent_tpu.utils.metrics import AverageMeter, accuracy, topk_correct  # noqa: F401

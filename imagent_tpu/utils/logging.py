@@ -1,0 +1,70 @@
+"""Process-0 logging: stdout epoch summaries + TensorBoard scalars.
+
+Mirrors the reference's L6 outputs (``imagenet.py:362-421``): a master-only
+``SummaryWriter`` with grouped scalars ``Loss``/``Top1``/``Top5`` (train +
+test series on one chart) and ``lr`` (``imagenet.py:405-421``), plus epoch
+summary prints (``imagenet.py:397-403``) and the final best/total summary
+(``imagenet.py:422-429``).
+"""
+
+from __future__ import annotations
+
+
+class TrainLogger:
+    """All methods no-op on non-master processes (``imagenet.py:362``)."""
+
+    def __init__(self, log_dir: str, is_master: bool, tensorboard: bool = True):
+        self.is_master = is_master
+        self.writer = None
+        if is_master and tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self.writer = SummaryWriter(log_dir)
+            except ImportError:
+                self.writer = None
+
+    def epoch_summary(self, epoch: int, lr: float, train: dict,
+                      val: dict | None, train_time: float,
+                      val_time: float) -> None:
+        """``val=None`` means no validation ran this epoch (eval_every>1) —
+        nothing is fabricated in its place."""
+        if not self.is_master:
+            return
+        line = (f"Epoch {epoch + 1}: lr {lr:g} | "
+                f"train loss {train['loss']:.4f} top1 {train['top1']:.3f} "
+                f"top5 {train['top5']:.3f} time {train_time:.1f}s")
+        if val is not None:
+            line += (f" | val loss {val['loss']:.4f} top1 {val['top1']:.3f} "
+                     f"top5 {val['top5']:.3f} time {val_time:.1f}s")
+        print(line, flush=True)
+
+    def scalars(self, epoch: int, lr: float, train: dict,
+                val: dict | None) -> None:
+        """Same scalar names/groupings as ``imagenet.py:405-421``; the
+        ``test`` series only gets points for epochs that actually ran
+        validation."""
+        if self.writer is None:
+            return
+        for group, key in (("Loss", "loss"), ("Top1", "top1"),
+                           ("Top5", "top5")):
+            series = {"train": train[key]}
+            if val is not None:
+                series["test"] = val[key]
+            self.writer.add_scalars(group, series, epoch)
+        self.writer.add_scalar("lr", lr, epoch)
+        self.writer.flush()
+
+    def final_summary(self, best_epoch: int, best_top1: float,
+                      best_top5: float, total_minutes: float) -> None:
+        """Reference's end-of-run block (``imagenet.py:422-429``,
+        visible at ``imagent_sgd.out:875-878``)."""
+        if not self.is_master:
+            return
+        print(f"Best top-1: {best_top1:.3f} (epoch {best_epoch + 1})",
+              flush=True)
+        print(f"Best top-5: {best_top5:.3f}", flush=True)
+        print(f"Total training time: {total_minutes:.2f} min", flush=True)
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()

@@ -1,0 +1,67 @@
+"""Metrics: running meters and top-k accuracy.
+
+Re-expresses the reference's L6 metric components:
+
+* ``AverageMeter`` — val/sum/count/avg accumulator (``imagenet.py:44-60``).
+  Kept host-side and exact; the reference's metering bug (weighting every
+  update by the channel count via ``input[0].size(0)``, ``imagenet.py:142``)
+  is deliberately NOT reproduced — updates are weighted by true batch size.
+* ``accuracy`` — top-k precision (``imagenet.py:63-79``): fraction of samples
+  whose target appears in the top-k logits, ×100.
+* Cross-rank reduction (``reduce_tensor``, ``imagenet.py:82-87``) is NOT a
+  host-side helper here: metrics are computed in-graph and ``psum``-meaned
+  inside the jitted step (see ``train.py``), collapsing the reference's 3
+  extra blocking allreduces per step (``imagenet.py:137-139``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class AverageMeter:
+    """Running value/sum/count/average (reference ``imagenet.py:44-60``)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = 0.0
+        self.sum = 0.0
+        self.count = 0
+        self.avg = 0.0
+
+    def update(self, val: float, n: int = 1) -> None:
+        self.val = float(val)
+        self.sum += float(val) * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"AverageMeter({self.name}: val={self.val:.4f} avg={self.avg:.4f})"
+
+
+def topk_correct(logits: jnp.ndarray, targets: jnp.ndarray,
+                 topk=(1, 5)) -> tuple[jnp.ndarray, ...]:
+    """Per-k correct counts, in-graph.
+
+    Rank-based formulation instead of the reference's
+    topk→transpose→eq→expand (``imagenet.py:71-78``): a sample is top-k
+    correct iff fewer than k logits strictly exceed the target's logit.
+    Ties resolve in our favor exactly like ``torch.topk``'s stable order
+    when the target is among equals; for continuous logits ties have
+    measure zero. Avoids materializing a (maxk, batch) comparison and maps
+    to one vectorized reduction on the VPU.
+    """
+    target_logit = jnp.take_along_axis(
+        logits, targets[:, None].astype(jnp.int32), axis=1)
+    rank = jnp.sum(logits > target_logit, axis=1)  # 0 = argmax
+    return tuple(jnp.sum(rank < k).astype(jnp.float32) for k in topk)
+
+
+def accuracy(logits: jnp.ndarray, targets: jnp.ndarray,
+             topk=(1, 5)) -> tuple[jnp.ndarray, ...]:
+    """Top-k precision ×100 over the batch (reference ``imagenet.py:63-79``)."""
+    batch = logits.shape[0]
+    return tuple(c * (100.0 / batch) for c in topk_correct(logits, targets, topk))

@@ -1,0 +1,29 @@
+"""Test harness: 8 fake CPU devices so the real SPMD path runs hardware-free.
+
+SURVEY §4 "Multi-device without a cluster": JAX's standard trick —
+``--xla_force_host_platform_device_count=8`` — lets every sharding/psum
+test exercise the genuine multi-chip code path on CPU.
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+# The sandbox's sitecustomize force-registers an experimental TPU platform
+# and appends it to jax_platforms; pin back to cpu before any backend init.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from imagent_tpu.cluster import make_mesh
+    return make_mesh(model_parallel=1)

@@ -1,0 +1,79 @@
+"""Unit tests for Slurm env parsing (SURVEY §4 "Multi-host logic"):
+the contract at reference ``imagenet.py:225-238``, tested with fake env
+dicts — no cluster needed."""
+
+from imagent_tpu.cluster import (
+    SlurmEnv, expand_nodelist, make_mesh, parse_slurm_env, rank_banner,
+    resolve_coordinator,
+)
+
+
+def test_expand_nodelist_range():
+    # The run of record's hosts: ener021..ener030 (imagent_sgd.out:10,265).
+    assert expand_nodelist("ener[021-030]") == [
+        f"ener{i:03d}" for i in range(21, 31)
+    ]
+
+
+def test_expand_nodelist_mixed():
+    assert expand_nodelist("n[1,3,5-7]") == ["n1", "n3", "n5", "n6", "n7"]
+    assert expand_nodelist("a1,b[2-3],c") == ["a1", "b2", "b3", "c"]
+    assert expand_nodelist("single-host") == ["single-host"]
+
+
+def test_expand_nodelist_suffix():
+    assert expand_nodelist("rack[01-02]-gpu") == ["rack01-gpu", "rack02-gpu"]
+
+
+def test_resolve_coordinator():
+    assert resolve_coordinator("ener[021-030]") == "ener021"
+    assert resolve_coordinator("hostA,hostB") == "hostA"
+
+
+def test_parse_slurm_env_16rank():
+    # The reference's 8 nodes x 2 tasks geometry (imagenet.sh:5-9).
+    env = {
+        "SLURM_JOB_NUM_NODES": "8",
+        "SLURM_NODEID": "3",
+        "SLURM_LOCALID": "1",
+        "SLURM_PROCID": "7",
+        "SLURM_NTASKS": "16",
+        "SLURM_JOB_NODELIST": "ener[021-028]",
+    }
+    s = parse_slurm_env(env)
+    assert s == SlurmEnv(n_nodes=8, node_id=3, local_rank=1, global_rank=7,
+                         world_size=16, coordinator="ener021")
+    assert not s.is_coordinator
+
+
+def test_parse_slurm_env_absent():
+    assert parse_slurm_env({}) is None
+    assert parse_slurm_env({"PATH": "/usr/bin"}) is None
+
+
+def test_parse_slurm_env_rank0_is_coordinator():
+    env = {"SLURM_JOB_NUM_NODES": "1", "SLURM_PROCID": "0",
+           "SLURM_NTASKS": "2", "SLURM_JOB_NODELIST": "h[1-2]"}
+    assert parse_slurm_env(env).is_coordinator
+
+
+def test_make_mesh_shapes():
+    m = make_mesh(model_parallel=1)
+    assert m.devices.shape == (8, 1)
+    assert m.axis_names == ("data", "model")
+    m2 = make_mesh(model_parallel=2)
+    assert m2.devices.shape == (4, 2)
+
+
+def test_make_mesh_indivisible():
+    import pytest
+    with pytest.raises(ValueError):
+        make_mesh(model_parallel=3)
+
+
+def test_rank_banner():
+    env = {"SLURM_JOB_NUM_NODES": "2", "SLURM_NODEID": "1",
+           "SLURM_LOCALID": "0", "SLURM_PROCID": "1", "SLURM_NTASKS": "2",
+           "SLURM_JOB_NODELIST": "h[1-2]"}
+    banner = rank_banner(parse_slurm_env(env))
+    assert "rank 1/2" in banner and "h1" in banner

@@ -1,0 +1,123 @@
+"""Data layer tests: DistributedSampler-equivalent sharding semantics
+(``imagenet.py:346-347,375``), eval padding, ImageFolder scanning."""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from imagent_tpu.config import Config
+from imagent_tpu.data.pipeline import pad_batch, shard_indices
+from imagent_tpu.data.synthetic import SyntheticLoader
+
+
+def test_shard_indices_partition_and_shuffle():
+    n, gb = 1000, 64
+    shards = [shard_indices(n, epoch=3, seed=0, process_index=p,
+                            process_count=4, shuffle=True,
+                            drop_remainder=True, global_batch=gb)
+              for p in range(4)]
+    all_rows = np.concatenate(shards)
+    assert len(all_rows) == (n // gb) * gb  # remainder dropped globally
+    assert len(np.unique(all_rows)) == len(all_rows)  # disjoint shards
+
+
+def test_shard_indices_epoch_reshuffle():
+    a = shard_indices(100, 0, 0, 0, 1, True, False, 10)
+    b = shard_indices(100, 1, 0, 0, 1, True, False, 10)
+    assert not np.array_equal(a, b)  # set_epoch reshuffle semantics
+    c = shard_indices(100, 0, 0, 0, 1, True, False, 10)
+    assert np.array_equal(a, c)  # deterministic per (seed, epoch)
+
+
+def test_shard_indices_eval_keeps_all():
+    from imagent_tpu.data.pipeline import PAD_ROW
+    shards = [shard_indices(103, 0, 0, p, 4, False, False, 16)
+              for p in range(4)]
+    real = np.concatenate(shards)
+    real = real[real != PAD_ROW]
+    assert len(real) == 103  # every sample exactly once
+    assert len(np.unique(real)) == 103
+    # equal slot counts per process (SPMD batch-count invariant)
+    assert len({len(s) for s in shards}) == 1
+
+
+def test_pad_batch():
+    img = np.ones((3, 4, 4, 3), np.float32)
+    lbl = np.arange(3, dtype=np.int32)
+    b = pad_batch(img, lbl, 8)
+    assert b.images.shape == (8, 4, 4, 3)
+    assert b.mask.sum() == 3.0
+    assert (b.mask[:3] == 1.0).all() and (b.mask[3:] == 0.0).all()
+
+
+def test_synthetic_loader_shapes_and_determinism():
+    cfg = Config(image_size=16, num_classes=4, synthetic_size=64, seed=0)
+    ld = SyntheticLoader(cfg, 0, 1, global_batch=16, train=True)
+    assert ld.steps_per_epoch == 4
+    batches = list(ld.epoch(0))
+    assert len(batches) == 4
+    assert batches[0].images.shape == (16, 16, 16, 3)
+    batches2 = list(ld.epoch(0))
+    np.testing.assert_array_equal(batches[0].images, batches2[0].images)
+    # different epoch → different order
+    b_e1 = list(ld.epoch(1))
+    assert not np.array_equal(batches[0].labels, b_e1[0].labels)
+
+
+def test_imagefolder_scan_and_decode(tmp_path):
+    # 2 classes × 3 images in torchvision ImageFolder layout.
+    rng = np.random.default_rng(0)
+    for cname in ["cat", "dog"]:
+        d = tmp_path / "train" / cname
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = rng.integers(0, 255, size=(20, 24, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.jpg")
+    (tmp_path / "val" / "cat").mkdir(parents=True)
+    (tmp_path / "val" / "dog").mkdir(parents=True)
+    Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(
+        tmp_path / "val" / "cat" / "0.jpg")
+    Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(
+        tmp_path / "val" / "dog" / "0.jpg")
+
+    from imagent_tpu.data.imagefolder import ImageFolderLoader, scan_imagefolder
+    paths, labels, classes = scan_imagefolder(str(tmp_path / "train"))
+    assert classes == ["cat", "dog"]  # sorted-class contract
+    assert len(paths) == 6 and list(np.bincount(labels)) == [3, 3]
+
+    cfg = Config(image_size=16, num_classes=2,
+                 data_root=str(tmp_path), workers=0)
+    ld = ImageFolderLoader(cfg, 0, 1, global_batch=2, split="train")
+    batches = list(ld.epoch(0))
+    assert len(batches) == 3
+    assert batches[0].images.shape == (2, 16, 16, 3)
+    assert batches[0].images.dtype == np.float32
+    # Normalize((.5,.5,.5),(.5,.5,.5)) maps [0,1] → [-1,1] (imagenet.py:283).
+    assert batches[0].images.min() >= -1.0 - 1e-6
+    assert batches[0].images.max() <= 1.0 + 1e-6
+
+    val = ImageFolderLoader(cfg, 0, 1, global_batch=4, split="val")
+    vb = list(val.epoch(0))
+    assert len(vb) == 1
+    assert vb[0].mask.sum() == 2.0  # 2 real, 2 padded
+
+
+def test_shard_indices_equal_batches_across_processes():
+    """SPMD invariant: every process must yield the SAME number of eval
+    batches or the psum in eval_step deadlocks multi-host (the
+    DistributedSampler padding invariant)."""
+    from imagent_tpu.data.pipeline import PAD_ROW, iter_batch_rows
+    n, gb, P = 9, 8, 2  # 9 samples, global batch 8, 2 hosts
+    local_rows = gb // P
+    counts, seen = [], []
+    for p in range(P):
+        idx = shard_indices(n, 0, 0, p, P, shuffle=False,
+                            drop_remainder=False, global_batch=gb)
+        batches = list(iter_batch_rows(idx, local_rows))
+        counts.append(len(batches))
+        for b in batches:
+            seen.extend([r for r in b if r != PAD_ROW])
+    assert counts == [2, 2]  # equal! (naive p::P split gives [2, 1])
+    assert sorted(seen) == list(range(9))  # all samples exactly once

@@ -1,0 +1,44 @@
+"""End-to-end CPU smoke (SURVEY §4 "Integration"): the full
+init→shard→step→psum→metrics→log→checkpoint path on 8 fake devices with
+synthetic data — the BASELINE.json "CPU smoke" config, hardware-free."""
+
+import numpy as np
+
+from imagent_tpu.config import Config
+from imagent_tpu.engine import run
+
+
+def _tiny_cfg(tmp_path, **kw):
+    base = dict(
+        arch="resnet18", image_size=16, num_classes=4, batch_size=4,
+        epochs=2, lr=0.05, dataset="synthetic", synthetic_size=128,
+        workers=0, bf16=False, log_every=0, seed=0,
+        log_dir=str(tmp_path / "tb"), ckpt_dir=str(tmp_path / "ckpt"))
+    base.update(kw)
+    return Config(**base)
+
+
+def test_e2e_loss_decreases_and_best_tracked(tmp_path):
+    cfg = _tiny_cfg(tmp_path, epochs=3, save_model=True)
+    result = run(cfg)
+    assert result["best_epoch"] >= 0
+    assert result["best_top1"] > 0.0  # learned something above chance start
+
+
+def test_e2e_resume_roundtrip(tmp_path):
+    cfg = _tiny_cfg(tmp_path, epochs=1, save_model=True)
+    run(cfg)
+    # Resume and continue to epoch 2; must pick up from saved state.
+    cfg2 = _tiny_cfg(tmp_path, epochs=2, save_model=True, resume=True)
+    result = run(cfg2)
+    assert result["best_epoch"] >= 0
+
+
+def test_e2e_learns_synthetic(tmp_path):
+    """The synthetic task is learnable: train top-1 beats chance clearly
+    after a few epochs (loss-decrease assertion per SURVEY §4 Integration).
+    Train metrics, not val: eval-mode BN running stats need far more steps
+    to burn in at these tiny batch sizes."""
+    cfg = _tiny_cfg(tmp_path, epochs=4, lr=0.1)
+    result = run(cfg)
+    assert result["final_train"]["top1"] > 40.0  # chance = 25%

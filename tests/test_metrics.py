@@ -1,0 +1,61 @@
+"""Metric math vs reference semantics (SURVEY §4 "Unit"):
+``accuracy`` top-k logic (``imagenet.py:63-79``) and the AverageMeter
+accumulator (``imagenet.py:44-60``) against hand-computed values."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from imagent_tpu.utils.metrics import AverageMeter, accuracy, topk_correct
+
+
+def test_average_meter_hand_computed():
+    m = AverageMeter("loss")
+    m.update(2.0, n=4)
+    m.update(1.0, n=4)
+    assert m.val == 1.0
+    assert m.sum == 12.0
+    assert m.count == 8
+    assert m.avg == pytest.approx(1.5)
+
+
+def test_average_meter_reset():
+    m = AverageMeter()
+    m.update(5.0)
+    m.reset()
+    assert m.count == 0 and m.avg == 0.0
+
+
+def test_accuracy_hand_computed():
+    # 4 samples, 6 classes. Targets: ranks 0, 1, 3, 5 respectively.
+    logits = jnp.array([
+        [9.0, 1.0, 2.0, 3.0, 4.0, 5.0],   # target 0 → rank 0 (top-1 hit)
+        [5.0, 4.0, 1.0, 2.0, 3.0, 0.0],   # target 1 → rank 1 (top-5 hit)
+        [5.0, 4.0, 3.0, 2.0, 1.0, 0.0],   # target 3 → rank 3 (top-5 hit)
+        [5.0, 4.0, 3.0, 2.0, 1.0, 0.0],   # target 5 → rank 5 (miss)
+    ])
+    targets = jnp.array([0, 1, 3, 5])
+    top1, top5 = accuracy(logits, targets, topk=(1, 5))
+    # Reference semantics (imagenet.py:71-78): correct_k * 100 / batch.
+    assert float(top1) == pytest.approx(25.0)
+    assert float(top5) == pytest.approx(75.0)
+
+
+def test_topk_correct_counts():
+    logits = jnp.eye(10) * 10.0
+    targets = jnp.arange(10)
+    c1, c5 = topk_correct(logits, targets)
+    assert float(c1) == 10.0 and float(c5) == 10.0
+
+
+def test_accuracy_matches_argsort_reference():
+    # Property check vs a brute-force top-k on random logits.
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(64, 100)).astype(np.float32)
+    targets = rng.integers(0, 100, size=(64,))
+    top1, top5 = accuracy(jnp.asarray(logits), jnp.asarray(targets))
+    order = np.argsort(-logits, axis=1)
+    ref1 = (order[:, 0] == targets).mean() * 100
+    ref5 = np.mean([t in order[i, :5] for i, t in enumerate(targets)]) * 100
+    assert float(top1) == pytest.approx(ref1, abs=1e-4)
+    assert float(top5) == pytest.approx(ref5, abs=1e-4)

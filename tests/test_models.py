@@ -1,0 +1,69 @@
+"""Model family tests (SURVEY §4 "Unit"): output shapes and parameter
+counts vs torchvision's published counts (11,689,512 for resnet18 at 1000
+classes — the reference's model, ``imagenet.py:312``)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from imagent_tpu.models import PARAM_COUNTS, create_model
+
+
+def n_params(tree):
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+@pytest.mark.parametrize("arch", ["resnet18", "resnet34", "resnet50"])
+def test_param_counts_match_torchvision(arch):
+    model = create_model(arch, num_classes=1000)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 64, 64, 3)), train=False)
+    assert n_params(variables["params"]) == PARAM_COUNTS[arch]
+
+
+@pytest.mark.parametrize("arch,count", [("resnet101", PARAM_COUNTS["resnet101"]),
+                                        ("resnet152", PARAM_COUNTS["resnet152"])])
+def test_param_counts_deep(arch, count):
+    model = create_model(arch, num_classes=10)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 32, 32, 3)), train=False)
+    # At 10 classes the head shrinks by 990*(512|2048)+990 params.
+    head_in = 512 if arch in ("resnet18", "resnet34") else 2048
+    assert n_params(variables["params"]) == count - 990 * head_in - 990
+
+
+def test_forward_shapes_and_dtype():
+    model = create_model("resnet18", num_classes=1000, bf16=True)
+    x = jnp.zeros((2, 64, 64, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 1000)
+    assert logits.dtype == jnp.float32  # head is fp32 even under bf16
+
+
+def test_batchnorm_state_updates_in_train_mode():
+    model = create_model("resnet18", num_classes=10)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x, train=True)
+    _, mutated = model.apply(variables, x, train=True,
+                             mutable=["batch_stats"])
+    before = variables["batch_stats"]["bn1"]["mean"]
+    after = mutated["batch_stats"]["bn1"]["mean"]
+    assert not jnp.allclose(before, after)
+
+
+def test_vit_param_counts_match_torchvision():
+    from imagent_tpu.models.vit import VIT_PARAM_COUNTS
+    model = create_model("vit_b16", num_classes=1000)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 224, 224, 3)), train=False)
+    assert n_params(variables["params"]) == VIT_PARAM_COUNTS["vit_b16"]
+
+
+def test_vit_forward_shape():
+    model = create_model("vit_b16", num_classes=10, bf16=True)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
