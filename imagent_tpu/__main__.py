@@ -5,7 +5,6 @@ The reference's ``__main__`` block (``imagenet.py:433-452``) — argparse →
 (see ``config.py``).
 """
 
-import os
 import sys
 
 from imagent_tpu.config import parse_args
@@ -13,9 +12,10 @@ from imagent_tpu.config import parse_args
 
 def main(argv=None) -> int:
     cfg = parse_args(argv)
-    if cfg.backend:
-        os.environ.setdefault("JAX_PLATFORMS", cfg.backend)
-    from imagent_tpu.engine import run  # import after platform selection
+    # Platform selection happens in cluster.initialize (called by run):
+    # --backend=tpu means "runtime auto-selects the accelerator"; cpu/gpu
+    # are forced explicitly there.
+    from imagent_tpu.engine import run
     run(cfg)
     return 0
 

@@ -147,8 +147,14 @@ def initialize(backend: str | None = None,
     selects the PJRT platform (the reference's ``--backend nccl`` analogue,
     ``imagenet.py:440``).
     """
-    if backend:
-        os.environ.setdefault("JAX_PLATFORMS", backend)
+    if backend and backend != "tpu":
+        # Force the requested platform. "tpu" deliberately leaves the
+        # runtime's own accelerator auto-selection in place (the TPU
+        # plugin's registered name varies across runtimes); "cpu"/"gpu"
+        # must win even over an environment-preset JAX_PLATFORMS — both in
+        # this process (jax.config) and in spawned workers (env var).
+        os.environ["JAX_PLATFORMS"] = backend
+        jax.config.update("jax_platforms", backend)
     senv = parse_slurm_env(env if env is not None else os.environ)
     if senv is not None and senv.world_size > 1:
         jax.distributed.initialize(

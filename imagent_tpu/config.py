@@ -54,11 +54,14 @@ class Config:
     profile: bool = False  # opt-in jax.profiler trace (SURVEY §5 tracing)
     check_nans: bool = False  # debug flag (SURVEY §5 sanitizers)
 
-    # ---- mesh geometry ----
+    # ---- mesh geometry / parallelism strategies ----
     # Data-parallel size is inferred (devices / model_parallel). A model axis
     # is first-class in the mesh design (SURVEY §2c disposition) even though
     # the parity workload only uses the data axis.
     model_parallel: int = 1
+    # Sequence parallelism over the model axis (ViT only):
+    # none | ring (ring attention) | ulysses (all-to-all head exchange).
+    seq_parallel: str = "none"
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -110,6 +113,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", action="store_true", default=False)
     p.add_argument("--check-nans", action="store_true", default=False)
     p.add_argument("--model-parallel", type=int, default=c.model_parallel)
+    p.add_argument("--seq-parallel", type=str, default=c.seq_parallel,
+                   choices=["none", "ring", "ulysses"])
     return p
 
 

@@ -92,8 +92,8 @@ def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
 
 def run(cfg: Config) -> dict:
     """Full training run. Returns the final summary dict."""
-    # cfg.backend selects the PJRT platform unless the environment already
-    # pinned one (cluster.initialize uses setdefault on JAX_PLATFORMS).
+    # cfg.backend selects the PJRT platform: "tpu" = runtime auto-select;
+    # "cpu"/"gpu" are forced, overriding any environment preset.
     senv = cluster.initialize(cfg.backend or None)
     print(cluster.rank_banner(senv), flush=True)
     is_master = jax.process_index() == 0
@@ -108,14 +108,28 @@ def run(cfg: Config) -> dict:
     train_loader, val_loader = make_loaders(
         cfg, jax.process_index(), jax.process_count(), global_batch)
 
-    model = create_model(cfg.arch, cfg.num_classes, cfg.bf16)
+    use_sp = cfg.seq_parallel != "none"
+    if use_sp and (not cfg.arch.startswith("vit") or cfg.model_parallel < 2):
+        raise ValueError(
+            "--seq-parallel requires a ViT arch and --model-parallel >= 2")
+    if use_sp:
+        model = create_model(
+            cfg.arch, cfg.num_classes, cfg.bf16, gap_readout=True,
+            attn_impl=cfg.seq_parallel, seq_axis=cluster.MODEL_AXIS,
+            seq_axis_size=cfg.model_parallel)
+        # Same param tree, no mesh-axis ops — usable for host-side init.
+        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                                  gap_readout=True)
+    else:
+        model = create_model(cfg.arch, cfg.num_classes, cfg.bf16)
+        init_model = model
     optimizer = make_optimizer(cfg.momentum, cfg.weight_decay)
     # Same seed on every process ⇒ identical init, the DDP broadcast
     # equivalence (imagenet.py:215,316).
     state = create_train_state(
-        model, jax.random.key(cfg.seed), cfg.image_size, optimizer)
+        init_model, jax.random.key(cfg.seed), cfg.image_size, optimizer)
     state = replicate_state(state, mesh)
-    train_step = make_train_step(model, optimizer, mesh)
+    train_step = make_train_step(model, optimizer, mesh, seq_parallel=use_sp)
     eval_step = make_eval_step(model, mesh)
 
     start_epoch, best_top1, best_top5, best_epoch = 0, 0.0, 0.0, -1

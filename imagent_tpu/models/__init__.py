@@ -33,12 +33,18 @@ def available_models() -> list[str]:
     return names
 
 
-def create_model(arch: str, num_classes: int = 1000, bf16: bool = False):
-    """Instantiate a model by name (the ``--arch`` flag)."""
+def create_model(arch: str, num_classes: int = 1000, bf16: bool = False,
+                 **overrides):
+    """Instantiate a model by name (the ``--arch`` flag). ``overrides``
+    are forwarded to ViT construction (e.g. the sequence-parallel knobs
+    ``attn_impl/seq_axis/seq_axis_size/gap_readout``)."""
     dtype = jnp.bfloat16 if bf16 else jnp.float32
     if arch.startswith("vit"):
         from imagent_tpu.models import vit
-        return vit.create_vit(arch, num_classes=num_classes, dtype=dtype)
+        return vit.create_vit(arch, num_classes=num_classes, dtype=dtype,
+                              **overrides)
+    if overrides:
+        raise ValueError(f"overrides {sorted(overrides)} only apply to ViT")
     if arch not in _REGISTRY:
         raise ValueError(f"unknown arch {arch!r}; one of {available_models()}")
     return _REGISTRY[arch](num_classes=num_classes, dtype=dtype)

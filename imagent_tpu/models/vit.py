@@ -17,8 +17,25 @@ from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 from imagent_tpu.ops.attention import dot_product_attention
+
+
+def _make_attn_fn(attn_impl: str, seq_axis: str | None):
+    """Select the attention implementation. ``ring``/``ulysses`` are the
+    sequence-parallel paths (parallel/ring_attention.py, parallel/ulysses.py)
+    and require running inside shard_map with the sequence sharded over
+    ``seq_axis``."""
+    if attn_impl == "full":
+        return lambda q, k, v: dot_product_attention(q, k, v)
+    if attn_impl == "ring":
+        from imagent_tpu.parallel.ring_attention import ring_attention
+        return lambda q, k, v: ring_attention(q, k, v, seq_axis)
+    if attn_impl == "ulysses":
+        from imagent_tpu.parallel.ulysses import ulysses_attention
+        return lambda q, k, v: ulysses_attention(q, k, v, seq_axis)
+    raise ValueError(f"unknown attn_impl {attn_impl!r}")
 
 
 class MultiHeadAttention(nn.Module):
@@ -27,6 +44,8 @@ class MultiHeadAttention(nn.Module):
 
     num_heads: int
     dtype: Any = jnp.float32
+    attn_impl: str = "full"
+    seq_axis: str | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -37,23 +56,29 @@ class MultiHeadAttention(nn.Module):
         q = dense(name="query")(x)
         k = dense(name="key")(x)
         v = dense(name="value")(x)
-        y = dot_product_attention(q, k, v)
+        y = _make_attn_fn(self.attn_impl, self.seq_axis)(q, k, v)
         return nn.DenseGeneral(features=d, axis=(-2, -1), dtype=self.dtype,
                                name="out")(y)
 
 
 class EncoderBlock(nn.Module):
-    """Pre-LN transformer block: x += MHA(LN(x)); x += MLP(LN(x))."""
+    """Pre-LN transformer block: x += MHA(LN(x)); x += MLP(LN(x)).
+
+    Every non-attention op is per-token, so under sequence parallelism the
+    block runs unchanged on each shard's token slice."""
 
     num_heads: int
     mlp_dim: int
     dtype: Any = jnp.float32
+    attn_impl: str = "full"
+    seq_axis: str | None = None
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="ln_1")(x)
         x = x + MultiHeadAttention(
-            self.num_heads, dtype=self.dtype, name="self_attention")(y)
+            self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
+            seq_axis=self.seq_axis, name="self_attention")(y)
         y = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="ln_2")(x)
         y = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_0")(y)
         y = nn.gelu(y, approximate=False)
@@ -62,6 +87,17 @@ class EncoderBlock(nn.Module):
 
 
 class VisionTransformer(nn.Module):
+    """Default path matches torchvision (class token readout). The
+    sequence-parallel path (``seq_axis`` set) uses global-average-pool
+    readout (``gap_readout``) so the token count divides evenly over the
+    mesh axis — cls-token handling would pin token 0 to shard 0.
+
+    Under sequence parallelism each (data, model) shard receives the full
+    image, patchifies (cheap, duplicated), slices its local token chunk by
+    mesh position, runs the encoder with ring/Ulysses attention across the
+    axis, and readout is a ``pmean`` over shards of the local token mean.
+    """
+
     patch_size: int = 16
     hidden_dim: int = 768
     num_layers: int = 12
@@ -69,6 +105,10 @@ class VisionTransformer(nn.Module):
     mlp_dim: int = 3072
     num_classes: int = 1000
     dtype: Any = jnp.float32
+    gap_readout: bool = False
+    attn_impl: str = "full"       # full | ring | ulysses
+    seq_axis: str | None = None   # mesh axis for sequence parallelism
+    seq_axis_size: int = 1        # static shard count over seq_axis
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -78,20 +118,44 @@ class VisionTransformer(nn.Module):
         x = nn.Conv(self.hidden_dim, (p, p), strides=(p, p),
                     padding="VALID", dtype=self.dtype, name="conv_proj")(x)
         b, h, w, d = x.shape
-        x = x.reshape(b, h * w, d)
-        cls = self.param("class_token", nn.initializers.zeros,
-                         (1, 1, d), jnp.float32).astype(self.dtype)
-        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, d)), x], axis=1)
+        n_tokens = h * w
+        x = x.reshape(b, n_tokens, d)
+        use_cls = not self.gap_readout and self.seq_axis is None
+        if use_cls:
+            cls = self.param("class_token", nn.initializers.zeros,
+                             (1, 1, d), jnp.float32).astype(self.dtype)
+            x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, d)), x], axis=1)
+            n_tokens += 1
         pos = self.param("pos_embedding",
                          nn.initializers.normal(stddev=0.02),
-                         (1, h * w + 1, d), jnp.float32)
+                         (1, n_tokens, d), jnp.float32)
         x = x + pos.astype(self.dtype)
+
+        if self.seq_axis is not None:
+            if n_tokens % self.seq_axis_size:
+                raise ValueError(
+                    f"{n_tokens} tokens not divisible by seq_axis_size="
+                    f"{self.seq_axis_size}")
+            n_local = n_tokens // self.seq_axis_size
+            idx = lax.axis_index(self.seq_axis)
+            x = lax.dynamic_slice_in_dim(x, idx * n_local, n_local, axis=1)
+
         for i in range(self.num_layers):
             x = EncoderBlock(self.num_heads, self.mlp_dim, dtype=self.dtype,
+                             attn_impl=self.attn_impl,
+                             seq_axis=self.seq_axis,
                              name=f"encoder_layer_{i}")(x)
         x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="ln")(x)
-        x = x[:, 0].astype(jnp.float32)  # class token, head in fp32
-        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        if use_cls:
+            pooled = x[:, 0]
+        else:
+            pooled = jnp.mean(x, axis=1)
+            if self.seq_axis is not None:
+                # equal chunks ⇒ global token mean = pmean of local means
+                pooled = lax.pmean(pooled, self.seq_axis)
+        pooled = pooled.astype(jnp.float32)  # head in fp32
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(pooled)
 
 
 VIT_REGISTRY = {
@@ -109,8 +173,11 @@ VIT_PARAM_COUNTS = {
 
 
 def create_vit(arch: str, num_classes: int = 1000,
-               dtype: Any = jnp.float32) -> VisionTransformer:
+               dtype: Any = jnp.float32, **overrides) -> VisionTransformer:
+    """``overrides`` reach the module directly — e.g. ``attn_impl="ring",
+    seq_axis="model", seq_axis_size=4, gap_readout=True`` for the
+    sequence-parallel configuration."""
     if arch not in VIT_REGISTRY:
         raise ValueError(f"unknown ViT arch {arch!r}")
     return VisionTransformer(num_classes=num_classes, dtype=dtype,
-                             **VIT_REGISTRY[arch])
+                             **VIT_REGISTRY[arch], **overrides)

@@ -1,0 +1,51 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The complementary long-context strategy to ring attention
+(``ring_attention.py``): instead of rotating K/V blocks, one
+``lax.all_to_all`` re-shards tensors from sequence-sharded to
+head-sharded, each device runs ordinary *full-sequence* attention on its
+subset of heads, and a second all-to-all restores sequence sharding.
+Two collectives total, each moving the tensor once over ICI — cheaper
+than the ring when heads ≥ ring size, but requires ``H % axis_size == 0``.
+
+Must be called inside ``shard_map`` with the sequence dimension sharded
+over ``axis_name``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from imagent_tpu.ops.attention import dot_product_attention
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str, causal: bool = False) -> jnp.ndarray:
+    """Shapes (per device): q/k/v ``(B, N_local, H, D)``; returns same.
+
+    Layout dance: all_to_all splits heads H into axis_size groups and
+    concatenates sequence shards, giving ``(B, N_global, H_local, D)``;
+    after local attention the inverse all_to_all restores
+    ``(B, N_local, H, D)``.
+    """
+    h = q.shape[2]
+    axis_size = lax.psum(1, axis_name)
+    if h % axis_size != 0:
+        raise ValueError(f"heads {h} not divisible by axis size {axis_size}")
+
+    def to_heads(x):  # (B, Nl, H, D) -> (B, N, Hl, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(x):  # (B, N, Hl, D) -> (B, Nl, H, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    mask = None
+    if causal:
+        n = qh.shape[1]
+        mask = jnp.tril(jnp.ones((n, n), bool))[None, None]
+    out = dot_product_attention(qh, kh, vh, mask=mask)
+    return to_seq(out)

@@ -43,7 +43,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from imagent_tpu.cluster import DATA_AXIS
+from imagent_tpu.cluster import DATA_AXIS, MODEL_AXIS
 from imagent_tpu.ops import softmax_cross_entropy
 from imagent_tpu.parallel import pmean_tree
 from imagent_tpu.utils.metrics import topk_correct
@@ -90,7 +90,8 @@ def create_train_state(model, rng: jax.Array, image_size: int,
 
 
 def make_train_step(model, optimizer: optax.GradientTransformation,
-                    mesh: Mesh, label_smoothing: float = 0.0) -> Callable:
+                    mesh: Mesh, label_smoothing: float = 0.0,
+                    seq_parallel: bool = False) -> Callable:
     """Build the jitted SPMD train step.
 
     ``shard_map`` over the ``data`` axis gives each device its batch shard
@@ -120,6 +121,14 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         # DDP gradient averaging (imagenet.py:316) — one fused allreduce.
         grads = pmean_tree(grads, DATA_AXIS)
         new_bs = pmean_tree(new_bs, DATA_AXIS)
+        if seq_parallel:
+            # Sequence-parallel models: the loss output is REPLICATED over
+            # the model axis (pmean readout), so SPMD autodiff seeds all P
+            # identical losses — each shard's grad is P x its true share
+            # of d(loss)/d(params). pmean both de-duplicates the P seeds
+            # and sums the per-shard partial contributions:
+            #   (1/P) * sum_i P * dL/dp_i = sum_i dL/dp_i = dL/dparams.
+            grads = pmean_tree(grads, MODEL_AXIS)
 
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params)

@@ -1,0 +1,88 @@
+"""Sequence-parallel ViT training equivalence: the full train step on a
+(1, 8) (data, model) mesh with ring/Ulysses attention must produce the
+same loss and updated parameters as the identical model run unsharded
+with full attention — validating the token slicing, ring collectives,
+pmean readout, and the model-axis gradient reduction in one shot."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from imagent_tpu.cluster import MODEL_AXIS, make_mesh
+from imagent_tpu.models.vit import VisionTransformer
+from imagent_tpu.train import (
+    TrainState, create_train_state, make_eval_step, make_optimizer,
+    make_train_step, replicate_state, shard_batch,
+)
+
+BATCH, SIZE, CLASSES = 4, 32, 8
+TINY = dict(patch_size=8, hidden_dim=32, num_layers=2, num_heads=8,
+            mlp_dim=64, num_classes=CLASSES)  # 16 tokens over 8 shards
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    images = rng.normal(size=(BATCH, SIZE, SIZE, 3)).astype(np.float32)
+    labels = rng.integers(0, CLASSES, size=(BATCH,)).astype(np.int32)
+    return images, labels
+
+
+def _ref_step_result(data):
+    """Unsharded reference: same model, full attention, 1-device mesh."""
+    images, labels = data
+    model = VisionTransformer(**TINY, gap_readout=True)
+    opt = make_optimizer()
+    mesh1 = make_mesh(model_parallel=1, devices=jax.devices()[:1])
+    state = replicate_state(
+        create_train_state(model, jax.random.key(0), SIZE, opt), mesh1)
+    step = make_train_step(model, opt, mesh1)
+    gi, gl = shard_batch(mesh1, images, labels)
+    new_state, metrics = step(state, gi, gl, np.float32(0.1))
+    return jax.device_get(new_state), np.asarray(metrics)
+
+
+@pytest.mark.parametrize("attn_impl", ["ring", "ulysses"])
+def test_seq_parallel_train_step_matches_unsharded(data, attn_impl):
+    images, labels = data
+    ref_state, ref_metrics = _ref_step_result(data)
+
+    mesh = make_mesh(model_parallel=8)  # (data=1, model=8)
+    model_sp = VisionTransformer(**TINY, gap_readout=True,
+                                 attn_impl=attn_impl, seq_axis=MODEL_AXIS,
+                                 seq_axis_size=8)
+    # Same init: the SP model adds no params, so reuse the reference tree.
+    ref_model = VisionTransformer(**TINY, gap_readout=True)
+    opt = make_optimizer()
+    state0 = create_train_state(ref_model, jax.random.key(0), SIZE, opt)
+    state0 = replicate_state(state0, mesh)
+
+    step = make_train_step(model_sp, opt, mesh, seq_parallel=True)
+    gi, gl = shard_batch(mesh, images, labels)
+    new_state, metrics = step(state0, gi, gl, np.float32(0.1))
+
+    np.testing.assert_allclose(np.asarray(metrics), ref_metrics,
+                               rtol=1e-4, atol=1e-4)
+    flat_ref = jax.tree.leaves(ref_state.params)
+    flat_got = jax.tree.leaves(jax.device_get(new_state.params))
+    for r, g in zip(flat_ref, flat_got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_seq_parallel_eval_step(data):
+    images, labels = data
+    mesh = make_mesh(model_parallel=8)
+    model_sp = VisionTransformer(**TINY, gap_readout=True, attn_impl="ring",
+                                 seq_axis=MODEL_AXIS, seq_axis_size=8)
+    ref_model = VisionTransformer(**TINY, gap_readout=True)
+    opt = make_optimizer()
+    state = replicate_state(
+        create_train_state(ref_model, jax.random.key(0), SIZE, opt), mesh)
+    eval_step = make_eval_step(model_sp, mesh)
+    mask = np.ones((BATCH,), np.float32)
+    gi, gl, gm = shard_batch(mesh, images, labels, mask)
+    m = np.asarray(eval_step(state, gi, gl, gm))
+    assert m.shape == (4,) and m[3] == BATCH
+    assert np.isfinite(m).all()
