@@ -39,6 +39,7 @@ class Config:
     lr_decay_period: int = 30  # imagenet.py:158
     lr_decay_factor: float = 0.1  # imagenet.py:158
     workers: int = 10  # imagenet.py:352
+    native_io: bool = True  # C++ threaded decode (imagent_tpu/native)
     log_dir: str = "runs/imagent_tpu"  # imagenet.py:363
     ckpt_dir: str = "checkpoints"  # imagenet.py:392 (file → dir for Orbax)
 
@@ -96,6 +97,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr-decay-period", type=int, default=c.lr_decay_period)
     p.add_argument("--lr-decay-factor", type=float, default=c.lr_decay_factor)
     p.add_argument("--workers", type=int, default=c.workers)
+    p.add_argument("--no-native-io", dest="native_io", action="store_false",
+                   default=True,
+                   help="disable the C++ decode path (PIL fallback)")
     p.add_argument("--log-dir", type=str, default=c.log_dir)
     p.add_argument("--ckpt-dir", type=str, default=c.ckpt_dir)
     # New capabilities.

@@ -84,8 +84,22 @@ class ImageFolderLoader:
         else:
             self.steps_per_epoch = -(-self.num_examples // global_batch)
         self._pool = None
+        self._use_native = None  # resolved lazily in _ensure_pool
+        self._warned_bad: set[str] = set()
 
     def _ensure_pool(self):
+        if self._use_native is None:
+            if self.cfg.native_io:
+                from imagent_tpu import native
+                self._use_native = native.available()
+            else:
+                self._use_native = False
+            if self._use_native:
+                # Fallback decoder (corrupt/odd files) runs in-process.
+                _init_worker(self.cfg.image_size, self.cfg.mean, self.cfg.std)
+                return
+        if self._use_native:
+            return
         if self._pool is None and self.cfg.workers > 0:
             import multiprocessing as mp
             # spawn, not fork: by loader time the PJRT runtime is live and
@@ -98,15 +112,41 @@ class ImageFolderLoader:
         elif self._pool is None:
             _init_worker(self.cfg.image_size, self.cfg.mean, self.cfg.std)
 
+    def _decode_native(self, paths: list[str]) -> np.ndarray:
+        from imagent_tpu import native
+        images, ok = native.decode_resize_batch(
+            paths, self.cfg.image_size, self.cfg.mean, self.cfg.std,
+            n_threads=max(1, self.cfg.workers))  # workers=0 ⇒ serial,
+        # matching the PIL path (native 0 would mean all-cores)
+        for i in np.flatnonzero(~ok):  # per-file PIL rescue (slow path)
+            try:
+                images[i] = _decode_one(paths[i])
+                if "rescue" not in self._warned_bad:
+                    self._warned_bad.add("rescue")
+                    print(f"NOTE: {paths[i]} not native-decodable "
+                          "(jpeg/png/webp); PIL slow path", flush=True)
+            except Exception:
+                # Undecodable by both decoders: zero-fill rather than
+                # killing a multi-hour run over one bad file.
+                images[i] = 0.0
+                if paths[i] not in self._warned_bad:
+                    self._warned_bad.add(paths[i])
+                    print(f"WARNING: undecodable image {paths[i]}; "
+                          "substituting zeros", flush=True)
+        return images
+
     def _decode_batch(self, rows: np.ndarray) -> Batch:
         valid = rows[rows != PAD_ROW]
         paths = [self.paths[i] for i in valid]
-        if self._pool is not None:
-            imgs = self._pool.map(_decode_one, paths, chunksize=8)
+        if self._use_native:
+            images = self._decode_native(paths)
         else:
-            imgs = [_decode_one(p) for p in paths]
-        images = (np.stack(imgs) if imgs else np.zeros(
-            (0, self.cfg.image_size, self.cfg.image_size, 3), np.float32))
+            if self._pool is not None:
+                imgs = self._pool.map(_decode_one, paths, chunksize=8)
+            else:
+                imgs = [_decode_one(p) for p in paths]
+            images = (np.stack(imgs) if imgs else np.zeros(
+                (0, self.cfg.image_size, self.cfg.image_size, 3), np.float32))
         labels = self.labels[valid].astype(np.int32)
         return pad_batch(images, labels, self.local_rows)
 
@@ -126,8 +166,9 @@ class ImageFolderLoader:
             try:
                 for rows in chunks:
                     q.put(self._decode_batch(rows))
-            finally:
                 q.put(None)
+            except BaseException as e:  # propagate, don't truncate the epoch
+                q.put(e)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
@@ -135,6 +176,9 @@ class ImageFolderLoader:
             item = q.get()
             if item is None:
                 break
+            if isinstance(item, BaseException):
+                t.join()
+                raise item
             yield item
         t.join()
 

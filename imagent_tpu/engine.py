@@ -105,18 +105,18 @@ def run(cfg: Config) -> dict:
         print(f"mesh {dict(mesh.shape)} global_batch {global_batch}",
               flush=True)
 
-    train_loader, val_loader = make_loaders(
-        cfg, jax.process_index(), jax.process_count(), global_batch)
-
     use_sp = cfg.seq_parallel != "none"
     if use_sp and (not cfg.arch.startswith("vit") or cfg.model_parallel < 2):
         raise ValueError(
             "--seq-parallel requires a ViT arch and --model-parallel >= 2")
+
+    train_loader, val_loader = make_loaders(
+        cfg, jax.process_index(), jax.process_count(), global_batch)
+
     if use_sp:
         model = create_model(
             cfg.arch, cfg.num_classes, cfg.bf16, gap_readout=True,
-            attn_impl=cfg.seq_parallel, seq_axis=cluster.MODEL_AXIS,
-            seq_axis_size=cfg.model_parallel)
+            attn_impl=cfg.seq_parallel, seq_axis=cluster.MODEL_AXIS)
         # Same param tree, no mesh-axis ops — usable for host-side init.
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                                   gap_readout=True)

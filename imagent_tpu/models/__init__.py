@@ -37,7 +37,7 @@ def create_model(arch: str, num_classes: int = 1000, bf16: bool = False,
                  **overrides):
     """Instantiate a model by name (the ``--arch`` flag). ``overrides``
     are forwarded to ViT construction (e.g. the sequence-parallel knobs
-    ``attn_impl/seq_axis/seq_axis_size/gap_readout``)."""
+    ``attn_impl/seq_axis/gap_readout``)."""
     dtype = jnp.bfloat16 if bf16 else jnp.float32
     if arch.startswith("vit"):
         from imagent_tpu.models import vit

@@ -108,7 +108,6 @@ class VisionTransformer(nn.Module):
     gap_readout: bool = False
     attn_impl: str = "full"       # full | ring | ulysses
     seq_axis: str | None = None   # mesh axis for sequence parallelism
-    seq_axis_size: int = 1        # static shard count over seq_axis
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -132,11 +131,14 @@ class VisionTransformer(nn.Module):
         x = x + pos.astype(self.dtype)
 
         if self.seq_axis is not None:
-            if n_tokens % self.seq_axis_size:
+            # Static under shard_map — derived from the live mesh, so it can
+            # never disagree with the actual axis size.
+            seq_size = lax.psum(1, self.seq_axis)
+            if n_tokens % seq_size:
                 raise ValueError(
-                    f"{n_tokens} tokens not divisible by seq_axis_size="
-                    f"{self.seq_axis_size}")
-            n_local = n_tokens // self.seq_axis_size
+                    f"{n_tokens} tokens not divisible by the {self.seq_axis}"
+                    f" axis size {seq_size}")
+            n_local = n_tokens // seq_size
             idx = lax.axis_index(self.seq_axis)
             x = lax.dynamic_slice_in_dim(x, idx * n_local, n_local, axis=1)
 
@@ -175,8 +177,8 @@ VIT_PARAM_COUNTS = {
 def create_vit(arch: str, num_classes: int = 1000,
                dtype: Any = jnp.float32, **overrides) -> VisionTransformer:
     """``overrides`` reach the module directly — e.g. ``attn_impl="ring",
-    seq_axis="model", seq_axis_size=4, gap_readout=True`` for the
-    sequence-parallel configuration."""
+    seq_axis="model", gap_readout=True`` for the sequence-parallel
+    configuration (the shard count comes from the live mesh axis)."""
     if arch not in VIT_REGISTRY:
         raise ValueError(f"unknown ViT arch {arch!r}")
     return VisionTransformer(num_classes=num_classes, dtype=dtype,

@@ -1,0 +1,3 @@
+from imagent_tpu.native.loader import (  # noqa: F401
+    available, decode_resize_batch,
+)

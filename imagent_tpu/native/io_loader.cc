@@ -1,0 +1,308 @@
+// Native batch image loader: threaded JPEG/PNG decode + triangle-filter
+// resize + normalize, writing float32 NHWC directly into a caller buffer.
+//
+// TPU-native equivalent of the reference's multiprocess pinned-memory
+// DataLoader (imagenet.py:350-359, 10 C-worker processes per rank): the
+// input pipeline is the host-CPU hot path (SURVEY §7 "Input pipeline
+// throughput"), so decode/resize runs in C++ with the GIL released —
+// one process, N threads, zero IPC serialization.
+//
+// Exposed C ABI (consumed by imagent_tpu/native/loader.py via ctypes):
+//   il_decode_resize_batch(paths, n, out_size, mean, std, out, ok, threads)
+//     -> number of failed images (their `ok` flag is 0; rows untouched)
+//
+// Decode fast path: libjpeg DCT scaling (M/8) picks the smallest decode
+// size that still covers the target, so a 2048px source headed for 448px
+// is decoded at ~1/4 cost before the resampler ever sees it.
+// Resampling: separable triangle (bilinear) filter with downscale-widened
+// support — the same family PIL's Image.BILINEAR uses, so outputs match
+// the pure-Python fallback path closely.
+
+#include <cstddef>
+#include <cstdio>
+// jpeglib.h requires stdio/stddef types to be declared before inclusion.
+#include <jpeglib.h>
+#include <png.h>
+#include <webp/decode.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jump;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+void jpeg_silent(j_common_ptr, int) {}
+
+// Decode a JPEG at >= target size using DCT scaling. RGB uint8 out.
+bool decode_jpeg(const char* path, int target, std::vector<uint8_t>* pix,
+                 int* w, int* h) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  jerr.mgr.emit_message = jpeg_silent;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    fclose(f);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_stdio_src(&cinfo, f);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  // Smallest M/8 scale whose decoded dims still cover the target on both
+  // axes (never upscale past the source).
+  int m = 8;
+  for (int cand = 1; cand <= 8; ++cand) {
+    long sw = (static_cast<long>(cinfo.image_width) * cand + 7) / 8;
+    long sh = (static_cast<long>(cinfo.image_height) * cand + 7) / 8;
+    if (sw >= target && sh >= target) { m = cand; break; }
+  }
+  cinfo.scale_num = m;
+  cinfo.scale_denom = 8;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  const int ch = cinfo.output_components;  // 3 after JCS_RGB
+  pix->resize(static_cast<size_t>(*w) * *h * 3);
+  std::vector<uint8_t> row(static_cast<size_t>(*w) * ch);
+  for (int y = 0; y < *h; ++y) {
+    uint8_t* rp = row.data();
+    jpeg_read_scanlines(&cinfo, &rp, 1);
+    uint8_t* dst = pix->data() + static_cast<size_t>(y) * *w * 3;
+    if (ch == 3) {
+      memcpy(dst, rp, static_cast<size_t>(*w) * 3);
+    } else {  // grayscale guard (JCS_RGB normally prevents this)
+      for (int x = 0; x < *w; ++x)
+        dst[3 * x] = dst[3 * x + 1] = dst[3 * x + 2] = rp[x * ch];
+    }
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  fclose(f);
+  return true;
+}
+
+// PNG via the libpng16 simplified API.
+bool decode_png(const char* path, std::vector<uint8_t>* pix, int* w, int* h) {
+  png_image image;
+  memset(&image, 0, sizeof(image));
+  image.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_file(&image, path)) return false;
+  image.format = PNG_FORMAT_RGB;
+  *w = image.width;
+  *h = image.height;
+  pix->resize(PNG_IMAGE_SIZE(image));
+  if (!png_image_finish_read(&image, nullptr, pix->data(), 0, nullptr)) {
+    png_image_free(&image);
+    return false;
+  }
+  return true;
+}
+
+// WebP via libwebp. Reads the whole file (webp has no streaming-decode
+// need at dataset-image sizes).
+bool decode_webp(const char* path, std::vector<uint8_t>* pix, int* w,
+                 int* h) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (sz <= 0 || sz > (64L << 20)) { fclose(f); return false; }
+  std::vector<uint8_t> buf(sz);
+  const bool read_ok = fread(buf.data(), 1, sz, f) == static_cast<size_t>(sz);
+  fclose(f);
+  if (!read_ok) return false;
+  int ww = 0, hh = 0;
+  if (!WebPGetInfo(buf.data(), buf.size(), &ww, &hh)) return false;
+  pix->resize(static_cast<size_t>(ww) * hh * 3);
+  if (!WebPDecodeRGBInto(buf.data(), buf.size(), pix->data(), pix->size(),
+                         ww * 3))
+    return false;
+  *w = ww;
+  *h = hh;
+  return true;
+}
+
+bool has_magic(const char* path, const uint8_t* magic, int n) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  uint8_t buf[8] = {0};
+  size_t got = fread(buf, 1, n, f);
+  fclose(f);
+  return got == static_cast<size_t>(n) && memcmp(buf, magic, n) == 0;
+}
+
+// Triangle-filter weights for one output axis (PIL ImagingResampleHorizontal
+// equivalent): support widens by the downscale factor so every source pixel
+// contributes — plain point-sampled bilinear aliases badly at 8x downscale.
+struct FilterTaps {
+  std::vector<int> xmin, xlen;
+  std::vector<float> weights;  // row-major [out, max_len]
+  int max_len = 0;
+};
+
+FilterTaps triangle_taps(int in_size, int out_size) {
+  FilterTaps t;
+  const double scale = static_cast<double>(in_size) / out_size;
+  const double fscale = std::max(scale, 1.0);
+  const double support = fscale;  // triangle support 1.0 * fscale
+  t.max_len = static_cast<int>(std::ceil(support)) * 2 + 1;
+  t.xmin.resize(out_size);
+  t.xlen.resize(out_size);
+  t.weights.assign(static_cast<size_t>(out_size) * t.max_len, 0.f);
+  for (int i = 0; i < out_size; ++i) {
+    const double center = (i + 0.5) * scale;
+    int x0 = static_cast<int>(center - support + 0.5);
+    int x1 = static_cast<int>(center + support + 0.5);
+    x0 = std::max(x0, 0);
+    x1 = std::min(x1, in_size);
+    double sum = 0.0;
+    std::vector<double> w(x1 - x0);
+    for (int x = x0; x < x1; ++x) {
+      double v = (x + 0.5 - center) / fscale;
+      v = 1.0 - std::abs(v);
+      w[x - x0] = v > 0 ? v : 0.0;
+      sum += w[x - x0];
+    }
+    t.xmin[i] = x0;
+    t.xlen[i] = x1 - x0;
+    for (int k = 0; k < x1 - x0; ++k)
+      t.weights[static_cast<size_t>(i) * t.max_len + k] =
+          static_cast<float>(sum > 0 ? w[k] / sum : 0.0);
+  }
+  return t;
+}
+
+// (h, w, 3) uint8 -> (size, size, 3) float32, then normalize in place.
+void resize_normalize(const uint8_t* pix, int w, int h, int size,
+                      const float* mean, const float* stddev, float* out) {
+  FilterTaps hx = triangle_taps(w, size);
+  FilterTaps vy = triangle_taps(h, size);
+  // Horizontal pass: (h, w, 3) -> (h, size, 3)
+  std::vector<float> tmp(static_cast<size_t>(h) * size * 3);
+  for (int y = 0; y < h; ++y) {
+    const uint8_t* src = pix + static_cast<size_t>(y) * w * 3;
+    float* dst = tmp.data() + static_cast<size_t>(y) * size * 3;
+    for (int i = 0; i < size; ++i) {
+      const float* wt = &hx.weights[static_cast<size_t>(i) * hx.max_len];
+      float acc0 = 0.f, acc1 = 0.f, acc2 = 0.f;
+      const int x0 = hx.xmin[i];
+      for (int k = 0; k < hx.xlen[i]; ++k) {
+        const uint8_t* p = src + static_cast<size_t>(x0 + k) * 3;
+        acc0 += wt[k] * p[0];
+        acc1 += wt[k] * p[1];
+        acc2 += wt[k] * p[2];
+      }
+      dst[3 * i] = acc0;
+      dst[3 * i + 1] = acc1;
+      dst[3 * i + 2] = acc2;
+    }
+  }
+  // Vertical pass + scale to [0,1] + normalize: (h, size, 3) -> (size, size, 3)
+  const float inv255 = 1.0f / 255.0f;
+  float scale_c[3], bias_c[3];
+  for (int c = 0; c < 3; ++c) {
+    scale_c[c] = inv255 / stddev[c];
+    bias_c[c] = -mean[c] / stddev[c];
+  }
+  for (int j = 0; j < size; ++j) {
+    const float* wt = &vy.weights[static_cast<size_t>(j) * vy.max_len];
+    const int y0 = vy.xmin[j];
+    float* dst = out + static_cast<size_t>(j) * size * 3;
+    for (int i = 0; i < size; ++i) {
+      float acc0 = 0.f, acc1 = 0.f, acc2 = 0.f;
+      for (int k = 0; k < vy.xlen[j]; ++k) {
+        const float* p =
+            tmp.data() + (static_cast<size_t>(y0 + k) * size + i) * 3;
+        acc0 += wt[k] * p[0];
+        acc1 += wt[k] * p[1];
+        acc2 += wt[k] * p[2];
+      }
+      dst[3 * i] = acc0 * scale_c[0] + bias_c[0];
+      dst[3 * i + 1] = acc1 * scale_c[1] + bias_c[1];
+      dst[3 * i + 2] = acc2 * scale_c[2] + bias_c[2];
+    }
+  }
+}
+
+const uint8_t kJpegMagic[] = {0xFF, 0xD8, 0xFF};
+const uint8_t kPngMagic[] = {0x89, 'P', 'N', 'G'};
+const uint8_t kRiffMagic[] = {'R', 'I', 'F', 'F'};
+
+bool decode_one(const char* path, int size, const float* mean,
+                const float* stddev, float* out) {
+  std::vector<uint8_t> pix;
+  int w = 0, h = 0;
+  bool ok = false;
+  if (has_magic(path, kJpegMagic, 3)) {
+    ok = decode_jpeg(path, size, &pix, &w, &h);
+  } else if (has_magic(path, kPngMagic, 4)) {
+    ok = decode_png(path, &pix, &w, &h);
+  } else if (has_magic(path, kRiffMagic, 4)) {
+    ok = decode_webp(path, &pix, &w, &h);
+  }
+  if (!ok || w <= 0 || h <= 0) return false;
+  resize_normalize(pix.data(), w, h, size, mean, stddev, out);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of images that FAILED to decode (ok[i] == 0 for those;
+// their output rows are left untouched for the Python fallback to fill).
+int64_t il_decode_resize_batch(const char* const* paths, int64_t n,
+                               int out_size, const float* mean,
+                               const float* stddev, float* out, uint8_t* ok,
+                               int n_threads) {
+  if (n <= 0) return 0;
+  const size_t row = static_cast<size_t>(out_size) * out_size * 3;
+  std::atomic<int64_t> next(0), failed(0);
+  auto work = [&]() {
+    for (;;) {
+      const int64_t i = next.fetch_add(1);
+      if (i >= n) return;
+      const bool good =
+          decode_one(paths[i], out_size, mean, stddev, out + i * row);
+      ok[i] = good ? 1 : 0;
+      if (!good) failed.fetch_add(1);
+    }
+  };
+  const int hw = static_cast<int>(std::thread::hardware_concurrency());
+  int nt = n_threads > 0 ? n_threads : std::max(1, hw);
+  nt = static_cast<int>(std::min<int64_t>(nt, n));
+  if (nt <= 1) {
+    work();
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(nt);
+    for (int t = 0; t < nt; ++t) threads.emplace_back(work);
+    for (auto& t : threads) t.join();
+  }
+  return failed.load();
+}
+
+int il_version() { return 1; }
+
+}  // extern "C"

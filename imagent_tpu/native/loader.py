@@ -1,0 +1,119 @@
+"""ctypes binding for the native C++ batch image loader.
+
+The C++ side (``io_loader.cc``) is the TPU-native replacement for the
+reference's native DataLoader workers (``imagenet.py:350-359``): threaded
+libjpeg/libpng decode + triangle resize + normalize with the GIL released.
+This module builds the shared library on demand with ``g++`` (toolchain is
+baked into the image; no pip/pybind11 needed), binds it via ctypes, and
+degrades gracefully — ``available()`` is False if the toolchain or headers
+are missing, and callers fall back to the pure-Python (PIL) path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "io_loader.cc")
+_LIB = os.path.join(_DIR, "libimagent_io.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _build() -> bool:
+    # Compile to a pid-unique temp path, then os.rename (atomic on POSIX):
+    # under multi-process launches on a shared filesystem, concurrent
+    # builders must never let a rank CDLL a half-written .so. No
+    # -march=native — the .so may be shared by heterogeneous hosts.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-shared",
+           "-o", tmp, _SRC, "-ljpeg", "-lpng", "-lwebp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        stale = (not os.path.exists(_LIB)
+                 or (os.path.exists(_SRC)
+                     and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)))
+        if stale and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.il_decode_resize_batch.restype = ctypes.c_int64
+        lib.il_decode_resize_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True once the native library is built and loadable."""
+    return _load() is not None
+
+
+def decode_resize_batch(paths: list[str], size: int, mean, std,
+                        n_threads: int = 0,
+                        out: np.ndarray | None = None,
+                        ) -> tuple[np.ndarray, np.ndarray]:
+    """Decode+resize+normalize a batch of image files natively.
+
+    Returns ``(images, ok)``: float32 (N, size, size, 3) and a bool mask of
+    successfully decoded rows (failed rows are zero; the caller re-decodes
+    those with PIL). ``out`` reuses a preallocated buffer across batches.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native loader unavailable")
+    n = len(paths)
+    if out is None or out.shape != (n, size, size, 3):
+        # np.empty, not zeros: every successfully decoded row is fully
+        # written by the C side; failed rows are zeroed below. NOTE: when
+        # batches are queued/prefetched, do NOT reuse one `out` across
+        # calls — in-flight batches would alias it.
+        out = np.empty((n, size, size, 3), np.float32)
+    ok = np.zeros((n,), np.uint8)
+    if n == 0:
+        return out, ok.astype(bool)
+    c_paths = (ctypes.c_char_p * n)(
+        *[os.fsencode(p) for p in paths])
+    mean_a = np.ascontiguousarray(mean, np.float32)
+    std_a = np.ascontiguousarray(std, np.float32)
+    lib.il_decode_resize_batch(
+        c_paths, n, size,
+        mean_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        int(n_threads))
+    okb = ok.astype(bool)
+    if not okb.all():
+        out[~okb] = 0.0
+    return out, okb

@@ -30,7 +30,7 @@ def _block_fold(q, k, v, o, m, l, scale, mask=None):
     q: (B, Nq, H, D); k/v: (B, Nk, H, D); o: (B, Nq, H, D) fp32;
     m, l: (B, H, Nq) fp32. Returns updated (o, m, l).
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k.astype(q.dtype),
                    preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask, s, _NEG_BIG)
@@ -62,26 +62,31 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     q_pos = my_idx * n_local + jnp.arange(n_local)  # global query positions
 
+    def block_mask(src):
+        if not causal:
+            return None
+        k_pos = src * n_local + jnp.arange(n_local)
+        return (k_pos[None, :] <= q_pos[:, None])[None, None]
+
     def body(i, carry):
         o, m, l, k_cur, v_cur = carry
-        if causal:
-            # After i right-rotations, the block on this device originated
-            # at ring position (my_idx - i) mod axis_size.
-            src = (my_idx - i) % axis_size
-            k_pos = src * n_local + jnp.arange(n_local)
-            mask = (k_pos[None, :] <= q_pos[:, None])[None, None]
-        else:
-            mask = None
-        o, m, l = _block_fold(qf, k_cur, v_cur, o, m, l, scale, mask)
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return o, m, l, k_nxt, v_nxt
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        # After i+1 right-rotations, the block on this device originated
+        # at ring position (my_idx - (i+1)) mod axis_size.
+        o, m, l = _block_fold(qf, k_cur, v_cur, o, m, l, scale,
+                              block_mask((my_idx - i - 1) % axis_size))
+        return o, m, l, k_cur, v_cur
 
     o0 = jnp.zeros((b, n_local, h, d), jnp.float32)
     m0 = jnp.full((b, h, n_local), _NEG_BIG, jnp.float32)
     l0 = jnp.zeros((b, h, n_local), jnp.float32)
+    # Local block folds outside the loop, so only axis_size-1 rotations run
+    # (a ring of 1 does zero collectives). K/V stay in their input dtype in
+    # the carry — the ppermute IS the critical path, and rotating bf16
+    # halves ICI bytes; _block_fold accumulates in fp32 regardless.
+    o, m, l = _block_fold(qf, k, v, o0, m0, l0, scale, block_mask(my_idx))
     o, m, l, _, _ = lax.fori_loop(
-        0, axis_size, body, (o0, m0, l0, k.astype(jnp.float32),
-                             v.astype(jnp.float32)))
+        0, axis_size - 1, body, (o, m, l, k, v))
     l_t = l.transpose(0, 2, 1)[..., None]           # (B, Nq, H, 1)
     return (o / jnp.maximum(l_t, 1e-30)).astype(out_dtype)

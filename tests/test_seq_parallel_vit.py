@@ -50,8 +50,7 @@ def test_seq_parallel_train_step_matches_unsharded(data, attn_impl):
 
     mesh = make_mesh(model_parallel=8)  # (data=1, model=8)
     model_sp = VisionTransformer(**TINY, gap_readout=True,
-                                 attn_impl=attn_impl, seq_axis=MODEL_AXIS,
-                                 seq_axis_size=8)
+                                 attn_impl=attn_impl, seq_axis=MODEL_AXIS)
     # Same init: the SP model adds no params, so reuse the reference tree.
     ref_model = VisionTransformer(**TINY, gap_readout=True)
     opt = make_optimizer()
@@ -75,7 +74,7 @@ def test_seq_parallel_eval_step(data):
     images, labels = data
     mesh = make_mesh(model_parallel=8)
     model_sp = VisionTransformer(**TINY, gap_readout=True, attn_impl="ring",
-                                 seq_axis=MODEL_AXIS, seq_axis_size=8)
+                                 seq_axis=MODEL_AXIS)
     ref_model = VisionTransformer(**TINY, gap_readout=True)
     opt = make_optimizer()
     state = replicate_state(
