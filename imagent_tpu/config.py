@@ -63,6 +63,9 @@ class Config:
     # Sequence parallelism over the model axis (ViT only):
     # none | ring (ring attention) | ulysses (all-to-all head exchange).
     seq_parallel: str = "none"
+    # Single-chip attention kernel (ViT only): full (XLA einsum) | flash
+    # (Pallas fused kernel, ops/flash_attention.py).
+    attn: str = "full"
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -119,6 +122,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-parallel", type=int, default=c.model_parallel)
     p.add_argument("--seq-parallel", type=str, default=c.seq_parallel,
                    choices=["none", "ring", "ulysses"])
+    p.add_argument("--attn", type=str, default=c.attn,
+                   choices=["full", "flash"],
+                   help="ViT attention kernel (flash = Pallas fused)")
     return p
 
 

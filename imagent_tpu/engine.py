@@ -109,6 +109,12 @@ def run(cfg: Config) -> dict:
     if use_sp and (not cfg.arch.startswith("vit") or cfg.model_parallel < 2):
         raise ValueError(
             "--seq-parallel requires a ViT arch and --model-parallel >= 2")
+    if cfg.attn != "full" and not cfg.arch.startswith("vit"):
+        raise ValueError(f"--attn={cfg.attn} requires a ViT arch "
+                         f"(got --arch={cfg.arch})")
+    if cfg.attn != "full" and use_sp:
+        raise ValueError("--attn and --seq-parallel are mutually exclusive: "
+                         "the seq-parallel kernels replace attention")
 
     train_loader, val_loader = make_loaders(
         cfg, jax.process_index(), jax.process_count(), global_batch)
@@ -120,6 +126,10 @@ def run(cfg: Config) -> dict:
         # Same param tree, no mesh-axis ops — usable for host-side init.
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                                   gap_readout=True)
+    elif cfg.arch.startswith("vit") and cfg.attn != "full":
+        model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                             attn_impl=cfg.attn)
+        init_model = model
     else:
         model = create_model(cfg.arch, cfg.num_classes, cfg.bf16)
         init_model = model

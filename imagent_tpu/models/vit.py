@@ -29,6 +29,9 @@ def _make_attn_fn(attn_impl: str, seq_axis: str | None):
     ``seq_axis``."""
     if attn_impl == "full":
         return lambda q, k, v: dot_product_attention(q, k, v)
+    if attn_impl == "flash":
+        from imagent_tpu.ops.flash_attention import flash_attention
+        return lambda q, k, v: flash_attention(q, k, v)
     if attn_impl == "ring":
         from imagent_tpu.parallel.ring_attention import ring_attention
         return lambda q, k, v: ring_attention(q, k, v, seq_axis)

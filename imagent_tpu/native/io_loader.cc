@@ -56,6 +56,10 @@ bool decode_jpeg(const char* path, int target, std::vector<uint8_t>* pix,
   if (!f) return false;
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
+  // Declared BEFORE setjmp: longjmp back into this scope keeps `row`
+  // alive (destructor runs at normal function exit) — declaring it after
+  // the setjmp point would skip its destructor on error (UB + leak).
+  std::vector<uint8_t> row;
   cinfo.err = jpeg_std_error(&jerr.mgr);
   jerr.mgr.error_exit = jpeg_err_exit;
   jerr.mgr.emit_message = jpeg_silent;
@@ -83,7 +87,7 @@ bool decode_jpeg(const char* path, int target, std::vector<uint8_t>* pix,
   *h = cinfo.output_height;
   const int ch = cinfo.output_components;  // 3 after JCS_RGB
   pix->resize(static_cast<size_t>(*w) * *h * 3);
-  std::vector<uint8_t> row(static_cast<size_t>(*w) * ch);
+  row.resize(static_cast<size_t>(*w) * ch);
   for (int y = 0; y < *h; ++y) {
     uint8_t* rp = row.data();
     jpeg_read_scanlines(&cinfo, &rp, 1);
@@ -140,6 +144,61 @@ bool decode_webp(const char* path, std::vector<uint8_t>* pix, int* w,
     return false;
   *w = ww;
   *h = hh;
+  return true;
+}
+
+// Minimal BMP decoder: uncompressed (BI_RGB) 24/32-bit, the overwhelmingly
+// common case for dataset BMPs; anything else falls to the PIL rescue.
+bool decode_bmp(const char* path, std::vector<uint8_t>* pix, int* w, int* h) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  uint8_t hdr[54];
+  if (fread(hdr, 1, 54, f) != 54 || hdr[0] != 'B' || hdr[1] != 'M') {
+    fclose(f);
+    return false;
+  }
+  auto rd32 = [&](int off) {
+    return static_cast<int32_t>(hdr[off] | hdr[off + 1] << 8 |
+                                hdr[off + 2] << 16 |
+                                static_cast<uint32_t>(hdr[off + 3]) << 24);
+  };
+  const uint32_t data_off = static_cast<uint32_t>(rd32(10));
+  const int32_t width = rd32(18);
+  int32_t height = rd32(22);
+  const uint16_t bpp = static_cast<uint16_t>(hdr[28] | hdr[29] << 8);
+  const int32_t compression = rd32(30);
+  const bool top_down = height < 0;
+  if (top_down) height = -height;
+  if (width <= 0 || height <= 0 || width > 1 << 16 || height > 1 << 16 ||
+      compression != 0 || (bpp != 24 && bpp != 32)) {
+    fclose(f);
+    return false;
+  }
+  const int ch = bpp / 8;
+  const size_t stride = (static_cast<size_t>(width) * ch + 3) & ~size_t{3};
+  std::vector<uint8_t> rowbuf(stride);
+  pix->resize(static_cast<size_t>(width) * height * 3);
+  if (fseek(f, static_cast<long>(data_off), SEEK_SET) != 0) {
+    fclose(f);
+    return false;
+  }
+  for (int32_t y = 0; y < height; ++y) {
+    if (fread(rowbuf.data(), 1, stride, f) != stride) {
+      fclose(f);
+      return false;
+    }
+    const int32_t dy = top_down ? y : height - 1 - y;  // BMP is bottom-up
+    uint8_t* dst = pix->data() + static_cast<size_t>(dy) * width * 3;
+    for (int32_t x = 0; x < width; ++x) {
+      const uint8_t* p = rowbuf.data() + static_cast<size_t>(x) * ch;
+      dst[3 * x] = p[2];  // BGR(A) -> RGB
+      dst[3 * x + 1] = p[1];
+      dst[3 * x + 2] = p[0];
+    }
+  }
+  fclose(f);
+  *w = width;
+  *h = height;
   return true;
 }
 
@@ -248,6 +307,7 @@ void resize_normalize(const uint8_t* pix, int w, int h, int size,
 const uint8_t kJpegMagic[] = {0xFF, 0xD8, 0xFF};
 const uint8_t kPngMagic[] = {0x89, 'P', 'N', 'G'};
 const uint8_t kRiffMagic[] = {'R', 'I', 'F', 'F'};
+const uint8_t kBmpMagic[] = {'B', 'M'};
 
 bool decode_one(const char* path, int size, const float* mean,
                 const float* stddev, float* out) {
@@ -260,6 +320,8 @@ bool decode_one(const char* path, int size, const float* mean,
     ok = decode_png(path, &pix, &w, &h);
   } else if (has_magic(path, kRiffMagic, 4)) {
     ok = decode_webp(path, &pix, &w, &h);
+  } else if (has_magic(path, kBmpMagic, 2)) {
+    ok = decode_bmp(path, &pix, &w, &h);
   }
   if (!ok || w <= 0 || h <= 0) return false;
   resize_normalize(pix.data(), w, h, size, mean, stddev, out);

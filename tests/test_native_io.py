@@ -51,6 +51,19 @@ def test_png_matches_pil(tmp_path):
     assert np.abs(out[0] - _pil_ref(p, 32)).max() < 0.02
 
 
+def test_bmp_and_webp_match_pil(tmp_path):
+    rng = np.random.default_rng(1)
+    arr = rng.integers(0, 255, (40, 56, 3), dtype=np.uint8)
+    pb = str(tmp_path / "a.bmp")
+    Image.fromarray(arr).save(pb)
+    pw = str(tmp_path / "a.webp")
+    Image.fromarray(arr).save(pw, lossless=True)
+    out, ok = native_loader.decode_resize_batch([pb, pw], 32, MEAN, STD)
+    assert ok.all()
+    for i, p in enumerate([pb, pw]):
+        assert np.abs(out[i] - _pil_ref(p, 32)).max() < 0.02
+
+
 def test_dct_scaled_decode_close_in_mean(tmp_path):
     # Large source → small target exercises the libjpeg M/8 fast path;
     # per-pixel deltas at sharp edges are expected (draft-decode tradeoff),
