@@ -58,12 +58,48 @@ def _init_worker(size: int, mean, std):
     _W["std"] = np.asarray(std, np.float32)
 
 
-def _decode_one(path: str) -> np.ndarray:
+def _sample_crop(w: int, h: int, rng: np.random.Generator):
+    """torchvision ``RandomResizedCrop.get_params`` (scale (0.08, 1),
+    ratio (3/4, 4/3)) + hflip(0.5) for the PIL fallback path. Same
+    algorithm as ``io_loader.cc::sample_crop`` (independent RNG stream —
+    both are valid augmentation draws)."""
+    area = w * h
+    for _ in range(10):
+        target_area = area * rng.uniform(0.08, 1.0)
+        ar = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+        cw = int(round(np.sqrt(target_area * ar)))
+        ch = int(round(np.sqrt(target_area / ar)))
+        if 0 < cw <= w and 0 < ch <= h:
+            x = int(rng.integers(0, w - cw + 1))
+            y = int(rng.integers(0, h - ch + 1))
+            return x, y, cw, ch, bool(rng.random() < 0.5)
+    in_ratio = w / h
+    if in_ratio < 3 / 4:
+        cw, ch = w, int(round(w / (3 / 4)))
+    elif in_ratio > 4 / 3:
+        cw, ch = int(round(h * (4 / 3))), h
+    else:
+        cw, ch = w, h
+    return (w - cw) // 2, (h - ch) // 2, cw, ch, bool(rng.random() < 0.5)
+
+
+def _decode_one(path: str, aug_seed: int | None = None) -> np.ndarray:
     size = _W["size"]
     with Image.open(path) as im:
-        im = im.convert("RGB").resize((size, size), Image.BILINEAR)
+        im = im.convert("RGB")
+        if aug_seed is not None:
+            x, y, cw, ch, flip = _sample_crop(
+                *im.size, np.random.default_rng(aug_seed))
+            im = im.resize((size, size), Image.BILINEAR,
+                           box=(x, y, x + cw, y + ch))
+            if flip:
+                im = im.transpose(Image.FLIP_LEFT_RIGHT)
+        else:
+            im = im.resize((size, size), Image.BILINEAR)
         arr = np.asarray(im, np.float32) / 255.0  # ToTensor scaling
     return (arr - _W["mean"]) / _W["std"]  # Normalize (imagenet.py:283)
+
+
 
 
 class ImageFolderLoader:
@@ -112,15 +148,18 @@ class ImageFolderLoader:
         elif self._pool is None:
             _init_worker(self.cfg.image_size, self.cfg.mean, self.cfg.std)
 
-    def _decode_native(self, paths: list[str]) -> np.ndarray:
+    def _decode_native(self, paths: list[str],
+                       seeds: np.ndarray | None) -> np.ndarray:
         from imagent_tpu import native
         images, ok = native.decode_resize_batch(
             paths, self.cfg.image_size, self.cfg.mean, self.cfg.std,
-            n_threads=max(1, self.cfg.workers))  # workers=0 ⇒ serial,
-        # matching the PIL path (native 0 would mean all-cores)
+            n_threads=max(1, self.cfg.workers),  # workers=0 ⇒ serial,
+            # matching the PIL path (native 0 would mean all-cores)
+            aug_seeds=seeds)
         for i in np.flatnonzero(~ok):  # per-file PIL rescue (slow path)
             try:
-                images[i] = _decode_one(paths[i])
+                images[i] = _decode_one(
+                    paths[i], int(seeds[i]) if seeds is not None else None)
                 if "rescue" not in self._warned_bad:
                     self._warned_bad.add("rescue")
                     print(f"NOTE: {paths[i]} not native-decodable "
@@ -135,16 +174,29 @@ class ImageFolderLoader:
                           "substituting zeros", flush=True)
         return images
 
-    def _decode_batch(self, rows: np.ndarray) -> Batch:
+    def _aug_seeds(self, rows: np.ndarray, epoch: int) -> np.ndarray | None:
+        """Per-sample uint64 seed, a pure function of (seed, epoch, dataset
+        row) — augmentation is reproducible and never repeats across
+        epochs (the ``set_epoch`` idea applied to the crop RNG)."""
+        if not (self.train and self.cfg.augment):
+            return None
+        return (rows.astype(np.uint64)
+                + np.uint64(epoch) * np.uint64(0x1_0000_0000)
+                + np.uint64(self.cfg.seed) * np.uint64(0x1000_0000_0000))
+
+    def _decode_batch(self, rows: np.ndarray, epoch: int) -> Batch:
         valid = rows[rows != PAD_ROW]
         paths = [self.paths[i] for i in valid]
+        seeds = self._aug_seeds(valid, epoch)
         if self._use_native:
-            images = self._decode_native(paths)
+            images = self._decode_native(paths, seeds)
         else:
+            args = [(p, int(seeds[i]) if seeds is not None else None)
+                    for i, p in enumerate(paths)]
             if self._pool is not None:
-                imgs = self._pool.map(_decode_one, paths, chunksize=8)
+                imgs = self._pool.starmap(_decode_one, args, chunksize=8)
             else:
-                imgs = [_decode_one(p) for p in paths]
+                imgs = [_decode_one(*a) for a in args]
             images = (np.stack(imgs) if imgs else np.zeros(
                 (0, self.cfg.image_size, self.cfg.image_size, 3), np.float32))
         labels = self.labels[valid].astype(np.int32)
@@ -165,7 +217,7 @@ class ImageFolderLoader:
         def producer():
             try:
                 for rows in chunks:
-                    q.put(self._decode_batch(rows))
+                    q.put(self._decode_batch(rows, epoch))
                 q.put(None)
             except BaseException as e:  # propagate, don't truncate the epoch
                 q.put(e)

@@ -37,6 +37,79 @@
 
 namespace {
 
+// Crop rectangle in decoded-image coordinates (float: JPEG DCT scaling
+// rescales a crop sampled in original coordinates) + horizontal flip.
+struct Crop {
+  float x = 0, y = 0, w = 0, h = 0;
+  bool flip = false;
+};
+
+// RandomResizedCrop + flip parameters (torchvision defaults when enabled
+// from Python: scale (0.08, 1.0), ratio (3/4, 4/3), hflip_prob 0.5).
+struct Aug {
+  float scale_min, scale_max, ratio_min, ratio_max, hflip_prob;
+};
+
+// splitmix64: deterministic per-(seed, epoch, sample) stream, so an epoch's
+// augmentation is reproducible across runs and across the native/PIL paths'
+// shared seed derivation.
+uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9E3779B97f4A7C15ull);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+float uniform01(uint64_t* s) {
+  return static_cast<float>(splitmix64(s) >> 11) * 0x1.0p-53f;
+}
+
+// torchvision RandomResizedCrop.get_params: 10 area/ratio attempts, then
+// a ratio-clamped center-crop fallback.
+Crop sample_crop(int w, int h, const Aug& aug, uint64_t seed) {
+  uint64_t s = seed;
+  Crop c;
+  const float area = static_cast<float>(w) * h;
+  const float log_rmin = std::log(aug.ratio_min);
+  const float log_rmax = std::log(aug.ratio_max);
+  for (int attempt = 0; attempt < 10; ++attempt) {
+    const float target_area =
+        area * (aug.scale_min +
+                uniform01(&s) * (aug.scale_max - aug.scale_min));
+    const float ar =
+        std::exp(log_rmin + uniform01(&s) * (log_rmax - log_rmin));
+    const int cw = static_cast<int>(std::lround(std::sqrt(target_area * ar)));
+    const int ch_ = static_cast<int>(std::lround(std::sqrt(target_area / ar)));
+    if (cw > 0 && ch_ > 0 && cw <= w && ch_ <= h) {
+      c.x = static_cast<float>(splitmix64(&s) % (w - cw + 1));
+      c.y = static_cast<float>(splitmix64(&s) % (h - ch_ + 1));
+      c.w = static_cast<float>(cw);
+      c.h = static_cast<float>(ch_);
+      c.flip = uniform01(&s) < aug.hflip_prob;
+      return c;
+    }
+  }
+  // Fallback: center crop at the nearest in-range aspect ratio.
+  const float in_ratio = static_cast<float>(w) / h;
+  int cw, ch_;
+  if (in_ratio < aug.ratio_min) {
+    cw = w;
+    ch_ = static_cast<int>(std::lround(w / aug.ratio_min));
+  } else if (in_ratio > aug.ratio_max) {
+    ch_ = h;
+    cw = static_cast<int>(std::lround(h * aug.ratio_max));
+  } else {
+    cw = w;
+    ch_ = h;
+  }
+  c.w = static_cast<float>(cw);
+  c.h = static_cast<float>(ch_);
+  c.x = static_cast<float>((w - cw) / 2);
+  c.y = static_cast<float>((h - ch_) / 2);
+  c.flip = uniform01(&s) < aug.hflip_prob;
+  return c;
+}
+
 struct JpegErr {
   jpeg_error_mgr mgr;
   jmp_buf jump;
@@ -50,8 +123,12 @@ void jpeg_err_exit(j_common_ptr cinfo) {
 void jpeg_silent(j_common_ptr, int) {}
 
 // Decode a JPEG at >= target size using DCT scaling. RGB uint8 out.
-bool decode_jpeg(const char* path, int target, std::vector<uint8_t>* pix,
-                 int* w, int* h) {
+// With `aug`, the crop is sampled in ORIGINAL coordinates from the header
+// dims (so augmentation statistics don't depend on the decode scale), the
+// DCT scale is chosen to keep the CROP at >= target size, and the crop is
+// rescaled into decoded coordinates on return.
+bool decode_jpeg(const char* path, int target, const Aug* aug, uint64_t seed,
+                 std::vector<uint8_t>* pix, int* w, int* h, Crop* crop) {
   FILE* f = fopen(path, "rb");
   if (!f) return false;
   jpeg_decompress_struct cinfo;
@@ -72,19 +149,36 @@ bool decode_jpeg(const char* path, int target, std::vector<uint8_t>* pix,
   jpeg_stdio_src(&cinfo, f);
   jpeg_read_header(&cinfo, TRUE);
   cinfo.out_color_space = JCS_RGB;
-  // Smallest M/8 scale whose decoded dims still cover the target on both
+  const int ow = static_cast<int>(cinfo.image_width);
+  const int oh = static_cast<int>(cinfo.image_height);
+  Crop c;  // original coordinates
+  if (aug) {
+    c = sample_crop(ow, oh, *aug, seed);
+  } else {
+    c.w = static_cast<float>(ow);
+    c.h = static_cast<float>(oh);
+  }
+  // Smallest M/8 scale whose decoded CROP still covers the target on both
   // axes (never upscale past the source).
   int m = 8;
   for (int cand = 1; cand <= 8; ++cand) {
-    long sw = (static_cast<long>(cinfo.image_width) * cand + 7) / 8;
-    long sh = (static_cast<long>(cinfo.image_height) * cand + 7) / 8;
-    if (sw >= target && sh >= target) { m = cand; break; }
+    if (c.w * cand / 8 >= target && c.h * cand / 8 >= target) {
+      m = cand;
+      break;
+    }
   }
   cinfo.scale_num = m;
   cinfo.scale_denom = 8;
   jpeg_start_decompress(&cinfo);
   *w = cinfo.output_width;
   *h = cinfo.output_height;
+  const float sx = static_cast<float>(*w) / ow;
+  const float sy = static_cast<float>(*h) / oh;
+  crop->x = c.x * sx;
+  crop->y = c.y * sy;
+  crop->w = c.w * sx;
+  crop->h = c.h * sy;
+  crop->flip = c.flip;
   const int ch = cinfo.output_components;  // 3 after JCS_RGB
   pix->resize(static_cast<size_t>(*w) * *h * 3);
   row.resize(static_cast<size_t>(*w) * ch);
@@ -220,9 +314,13 @@ struct FilterTaps {
   int max_len = 0;
 };
 
-FilterTaps triangle_taps(int in_size, int out_size) {
+// Taps mapping out_size output pixels onto the source span
+// [offset, offset + span) of an axis with in_size pixels (offset/span are
+// float: crops inherit fractional coordinates from JPEG DCT scaling).
+FilterTaps triangle_taps(int in_size, int out_size, double offset,
+                         double span) {
   FilterTaps t;
-  const double scale = static_cast<double>(in_size) / out_size;
+  const double scale = span / out_size;
   const double fscale = std::max(scale, 1.0);
   const double support = fscale;  // triangle support 1.0 * fscale
   t.max_len = static_cast<int>(std::ceil(support)) * 2 + 1;
@@ -230,7 +328,7 @@ FilterTaps triangle_taps(int in_size, int out_size) {
   t.xlen.resize(out_size);
   t.weights.assign(static_cast<size_t>(out_size) * t.max_len, 0.f);
   for (int i = 0; i < out_size; ++i) {
-    const double center = (i + 0.5) * scale;
+    const double center = offset + (i + 0.5) * scale;
     int x0 = static_cast<int>(center - support + 0.5);
     int x1 = static_cast<int>(center + support + 0.5);
     x0 = std::max(x0, 0);
@@ -252,11 +350,22 @@ FilterTaps triangle_taps(int in_size, int out_size) {
   return t;
 }
 
-// (h, w, 3) uint8 -> (size, size, 3) float32, then normalize in place.
-void resize_normalize(const uint8_t* pix, int w, int h, int size,
-                      const float* mean, const float* stddev, float* out) {
-  FilterTaps hx = triangle_taps(w, size);
-  FilterTaps vy = triangle_taps(h, size);
+// (h, w, 3) uint8 -> crop -> (size, size, 3) float32, normalized; the
+// horizontal flip folds into the horizontal tap order for free.
+void resize_normalize(const uint8_t* pix, int w, int h, const Crop& crop,
+                      int size, const float* mean, const float* stddev,
+                      float* out) {
+  FilterTaps hx = triangle_taps(w, size, crop.x, crop.w);
+  FilterTaps vy = triangle_taps(h, size, crop.y, crop.h);
+  if (crop.flip) {  // reverse the output-column order of the taps
+    std::reverse(hx.xmin.begin(), hx.xmin.end());
+    std::reverse(hx.xlen.begin(), hx.xlen.end());
+    std::vector<float> rev(hx.weights.size());
+    for (int i = 0; i < size; ++i)
+      std::copy_n(&hx.weights[static_cast<size_t>(size - 1 - i) * hx.max_len],
+                  hx.max_len, &rev[static_cast<size_t>(i) * hx.max_len]);
+    hx.weights.swap(rev);
+  }
   // Horizontal pass: (h, w, 3) -> (h, size, 3)
   std::vector<float> tmp(static_cast<size_t>(h) * size * 3);
   for (int y = 0; y < h; ++y) {
@@ -309,13 +418,16 @@ const uint8_t kPngMagic[] = {0x89, 'P', 'N', 'G'};
 const uint8_t kRiffMagic[] = {'R', 'I', 'F', 'F'};
 const uint8_t kBmpMagic[] = {'B', 'M'};
 
-bool decode_one(const char* path, int size, const float* mean,
-                const float* stddev, float* out) {
+bool decode_one(const char* path, int size, const Aug* aug, uint64_t seed,
+                const float* mean, const float* stddev, float* out) {
   std::vector<uint8_t> pix;
   int w = 0, h = 0;
   bool ok = false;
+  Crop crop;
+  bool have_crop = false;
   if (has_magic(path, kJpegMagic, 3)) {
-    ok = decode_jpeg(path, size, &pix, &w, &h);
+    ok = decode_jpeg(path, size, aug, seed, &pix, &w, &h, &crop);
+    have_crop = ok;
   } else if (has_magic(path, kPngMagic, 4)) {
     ok = decode_png(path, &pix, &w, &h);
   } else if (has_magic(path, kRiffMagic, 4)) {
@@ -324,7 +436,15 @@ bool decode_one(const char* path, int size, const float* mean,
     ok = decode_bmp(path, &pix, &w, &h);
   }
   if (!ok || w <= 0 || h <= 0) return false;
-  resize_normalize(pix.data(), w, h, size, mean, stddev, out);
+  if (!have_crop) {
+    if (aug) {
+      crop = sample_crop(w, h, *aug, seed);
+    } else {
+      crop.w = static_cast<float>(w);
+      crop.h = static_cast<float>(h);
+    }
+  }
+  resize_normalize(pix.data(), w, h, crop, size, mean, stddev, out);
   return true;
 }
 
@@ -334,11 +454,23 @@ extern "C" {
 
 // Returns the number of images that FAILED to decode (ok[i] == 0 for those;
 // their output rows are left untouched for the Python fallback to fill).
+// `aug_params` (5 floats: scale_min, scale_max, ratio_min, ratio_max,
+// hflip_prob) and `aug_seeds` (one uint64 per image) are both NULL for the
+// plain resize path, both non-NULL for RandomResizedCrop + flip.
 int64_t il_decode_resize_batch(const char* const* paths, int64_t n,
                                int out_size, const float* mean,
-                               const float* stddev, float* out, uint8_t* ok,
-                               int n_threads) {
+                               const float* stddev,
+                               const float* aug_params,
+                               const uint64_t* aug_seeds, float* out,
+                               uint8_t* ok, int n_threads) {
   if (n <= 0) return 0;
+  Aug aug_val{};
+  const Aug* aug = nullptr;
+  if (aug_params && aug_seeds) {
+    aug_val = Aug{aug_params[0], aug_params[1], aug_params[2], aug_params[3],
+                  aug_params[4]};
+    aug = &aug_val;
+  }
   const size_t row = static_cast<size_t>(out_size) * out_size * 3;
   std::atomic<int64_t> next(0), failed(0);
   auto work = [&]() {
@@ -346,7 +478,8 @@ int64_t il_decode_resize_batch(const char* const* paths, int64_t n,
       const int64_t i = next.fetch_add(1);
       if (i >= n) return;
       const bool good =
-          decode_one(paths[i], out_size, mean, stddev, out + i * row);
+          decode_one(paths[i], out_size, aug, aug ? aug_seeds[i] : 0, mean,
+                     stddev, out + i * row);
       ok[i] = good ? 1 : 0;
       if (!good) failed.fetch_add(1);
     }
@@ -365,6 +498,6 @@ int64_t il_decode_resize_batch(const char* const* paths, int64_t n,
   return failed.load();
 }
 
-int il_version() { return 1; }
+int il_version() { return 2; }
 
 }  // extern "C"

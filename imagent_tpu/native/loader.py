@@ -26,6 +26,19 @@ _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _load_failed = False
 
+# Must match io_loader.cc::il_version(). Bump BOTH on any C-ABI change.
+_ABI_VERSION = 2
+
+
+def _abi_version(lib: ctypes.CDLL) -> int:
+    try:
+        fn = lib.il_version
+    except AttributeError:
+        return -1
+    fn.restype = ctypes.c_int
+    fn.argtypes = []
+    return int(fn())
+
 
 def _build() -> bool:
     # Compile to a pid-unique temp path, then os.rename (atomic on POSIX):
@@ -63,10 +76,24 @@ def _load() -> ctypes.CDLL | None:
         except OSError:
             _load_failed = True
             return None
+        if _abi_version(lib) != _ABI_VERSION:
+            # Stale binary with a different calling convention (e.g. built
+            # by an older checkout on a shared FS): rebuild once, else fail
+            # over to the PIL path rather than corrupting memory.
+            lib = None
+            if _build():
+                try:
+                    lib = ctypes.CDLL(_LIB)
+                except OSError:
+                    lib = None
+            if lib is None or _abi_version(lib) != _ABI_VERSION:
+                _load_failed = True
+                return None
         lib.il_decode_resize_batch.restype = ctypes.c_int64
         lib.il_decode_resize_batch.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64, ctypes.c_int,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_int,
         ]
@@ -79,15 +106,26 @@ def available() -> bool:
     return _load() is not None
 
 
+DEFAULT_AUG = (0.08, 1.0, 3.0 / 4.0, 4.0 / 3.0, 0.5)
+"""torchvision RandomResizedCrop defaults + hflip p: (scale_min, scale_max,
+ratio_min, ratio_max, hflip_prob)."""
+
+
 def decode_resize_batch(paths: list[str], size: int, mean, std,
                         n_threads: int = 0,
                         out: np.ndarray | None = None,
+                        aug_seeds: np.ndarray | None = None,
+                        aug_params: tuple = DEFAULT_AUG,
                         ) -> tuple[np.ndarray, np.ndarray]:
     """Decode+resize+normalize a batch of image files natively.
 
     Returns ``(images, ok)``: float32 (N, size, size, 3) and a bool mask of
     successfully decoded rows (failed rows are zero; the caller re-decodes
     those with PIL). ``out`` reuses a preallocated buffer across batches.
+
+    ``aug_seeds`` (uint64, one per image) switches on RandomResizedCrop +
+    horizontal flip with ``aug_params`` bounds; each image's crop is a pure
+    function of its seed, so epochs are reproducible.
     """
     lib = _load()
     if lib is None:
@@ -106,10 +144,21 @@ def decode_resize_batch(paths: list[str], size: int, mean, std,
         *[os.fsencode(p) for p in paths])
     mean_a = np.ascontiguousarray(mean, np.float32)
     std_a = np.ascontiguousarray(std, np.float32)
+    if aug_seeds is not None:
+        if len(aug_seeds) != n:
+            raise ValueError(f"{len(aug_seeds)} seeds for {n} images")
+        seeds_a = np.ascontiguousarray(aug_seeds, np.uint64)
+        params_a = np.ascontiguousarray(aug_params, np.float32)
+        c_seeds = seeds_a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+        c_params = params_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    else:
+        c_seeds = None
+        c_params = None
     lib.il_decode_resize_batch(
         c_paths, n, size,
         mean_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         std_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        c_params, c_seeds,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         int(n_threads))

@@ -114,6 +114,71 @@ def test_imagefolder_uses_native_and_rescues(tmp_path):
     ld.close()
 
 
+def test_augment_deterministic_and_varying(tmp_path):
+    p = str(tmp_path / "a.jpg")
+    Image.fromarray(_smooth(200, 300)).save(p, quality=95)
+    seeds_a = np.array([7, 8, 9], np.uint64)
+    out1, ok1 = native_loader.decode_resize_batch(
+        [p, p, p], 64, MEAN, STD, aug_seeds=seeds_a)
+    out2, ok2 = native_loader.decode_resize_batch(
+        [p, p, p], 64, MEAN, STD, aug_seeds=seeds_a)
+    assert ok1.all() and ok2.all()
+    np.testing.assert_array_equal(out1, out2)  # same seed → same crop
+    # different seeds → different crops (same image decoded 3 ways)
+    assert np.abs(out1[0] - out1[1]).max() > 1e-3
+    assert np.abs(out1[1] - out1[2]).max() > 1e-3
+    # no-aug call unchanged by the new parameters
+    plain, _ = native_loader.decode_resize_batch([p], 64, MEAN, STD)
+    np.testing.assert_allclose(plain[0], _pil_ref(p, 64), atol=0.05)
+
+
+def test_augment_values_stay_in_image_range(tmp_path):
+    # Crops must never read out of bounds: constant image ⇒ constant crops.
+    p = str(tmp_path / "c.png")
+    Image.fromarray(np.full((90, 130, 3), 200, np.uint8)).save(p)
+    seeds = np.arange(16, dtype=np.uint64)
+    out, ok = native_loader.decode_resize_batch(
+        [p] * 16, 32, MEAN, STD, aug_seeds=seeds)
+    assert ok.all()
+    expect = (200 / 255.0 - 0.5) / 0.5
+    np.testing.assert_allclose(out, expect, atol=2e-2)
+
+
+def test_imagefolder_augment_epoch_variation(tmp_path):
+    for cname in ("ant", "bee"):
+        d = tmp_path / "train" / cname
+        d.mkdir(parents=True)
+        for i in range(3):
+            Image.fromarray(_smooth(80, 100)).save(d / f"{i}.jpg")
+    (tmp_path / "val").mkdir()
+    from imagent_tpu.data.imagefolder import ImageFolderLoader
+    cfg = Config(data_root=str(tmp_path), image_size=32, workers=0,
+                 augment=True, seed=3)
+    ld = ImageFolderLoader(cfg, 0, 1, global_batch=6, split="train")
+    (b0,), (b0_again,) = list(ld.epoch(0)), list(ld.epoch(0))
+    np.testing.assert_array_equal(b0.images, b0_again.images)  # reproducible
+    (b1,) = list(ld.epoch(1))
+    assert not np.array_equal(b0.images, b1.images)  # re-augmented per epoch
+
+
+def test_pil_fallback_augment(tmp_path):
+    # The PIL path (native_io=False) augments too, deterministically.
+    for cname in ("ant",):
+        d = tmp_path / "train" / cname
+        d.mkdir(parents=True)
+        for i in range(2):
+            Image.fromarray(_smooth(70, 90)).save(d / f"{i}.jpg")
+    (tmp_path / "val").mkdir()
+    from imagent_tpu.data.imagefolder import ImageFolderLoader
+    cfg = Config(data_root=str(tmp_path), image_size=24, workers=0,
+                 augment=True, native_io=False)
+    ld = ImageFolderLoader(cfg, 0, 1, global_batch=2, split="train")
+    (a,), (b,) = list(ld.epoch(0)), list(ld.epoch(0))
+    np.testing.assert_array_equal(a.images, b.images)
+    (c,) = list(ld.epoch(1))
+    assert not np.array_equal(a.images, c.images)
+
+
 def test_native_matches_python_fallback_pipeline(tmp_path):
     # The two pipeline variants must deliver (nearly) identical batches.
     for cname in ("ant", "bee"):
