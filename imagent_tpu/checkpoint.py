@@ -34,7 +34,10 @@ def save(ckpt_dir: str, name: str, state: TrainState, meta: dict) -> None:
     coordinates across processes; the JSON sidecar is process-0 only."""
     path = os.path.abspath(os.path.join(ckpt_dir, name))
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, jax.device_get(state), force=True)
+    # Hand Orbax the jax.Arrays as-is: it gathers sharded leaves itself
+    # (a tensor-parallel state spans hosts — a host-side device_get here
+    # would crash on non-addressable shards).
+    ckptr.save(path, state, force=True)
     ckptr.wait_until_finished()
     if jax.process_index() == 0:
         with open(_meta_path(ckpt_dir, name), "w") as f:

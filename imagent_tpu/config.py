@@ -67,6 +67,9 @@ class Config:
     # Sequence parallelism over the model axis (ViT only):
     # none | ring (ring attention) | ulysses (all-to-all head exchange).
     seq_parallel: str = "none"
+    # Megatron-style tensor parallelism over the model axis (ViT only):
+    # heads + MLP hidden shard across chips (parallel/tensor_parallel.py).
+    tensor_parallel: bool = False
     # Single-chip attention kernel (ViT only): full (XLA einsum) | flash
     # (Pallas fused kernel, ops/flash_attention.py).
     attn: str = "full"
@@ -129,6 +132,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-parallel", type=int, default=c.model_parallel)
     p.add_argument("--seq-parallel", type=str, default=c.seq_parallel,
                    choices=["none", "ring", "ulysses"])
+    p.add_argument("--tensor-parallel", action="store_true", default=False,
+                   help="shard attention heads + MLP over the model axis")
     p.add_argument("--attn", type=str, default=c.attn,
                    choices=["full", "flash"],
                    help="ViT attention kernel (flash = Pallas fused)")

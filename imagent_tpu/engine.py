@@ -34,7 +34,8 @@ from imagent_tpu.models import create_model
 from imagent_tpu.schedule import lr_for_epoch
 from imagent_tpu.train import (
     TrainState, create_train_state, make_eval_step, make_optimizer,
-    make_train_step, replicate_state, shard_batch,
+    make_train_step, place_state, replicate_state, shard_batch,
+    state_partition_specs,
 )
 from imagent_tpu.utils.logging import TrainLogger
 from imagent_tpu.utils.metrics import AverageMeter
@@ -115,6 +116,14 @@ def run(cfg: Config) -> dict:
     if cfg.attn != "full" and use_sp:
         raise ValueError("--attn and --seq-parallel are mutually exclusive: "
                          "the seq-parallel kernels replace attention")
+    use_tp = cfg.tensor_parallel
+    if use_tp and (not cfg.arch.startswith("vit") or cfg.model_parallel < 2):
+        raise ValueError(
+            "--tensor-parallel requires a ViT arch and --model-parallel >= 2")
+    if use_tp and use_sp:
+        raise ValueError(
+            "--tensor-parallel and --seq-parallel both consume the model "
+            "axis; pick one")
 
     train_loader, val_loader = make_loaders(
         cfg, jax.process_index(), jax.process_count(), global_batch)
@@ -126,6 +135,13 @@ def run(cfg: Config) -> dict:
         # Same param tree, no mesh-axis ops — usable for host-side init.
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                                   gap_readout=True)
+    elif use_tp:
+        model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                             attn_impl=cfg.attn, tp_axis=cluster.MODEL_AXIS)
+        # Host-side init uses the unsharded twin; TP consumes slices of
+        # the same param tree (parallel/tensor_parallel.py).
+        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                                  attn_impl=cfg.attn)
     elif cfg.arch.startswith("vit") and cfg.attn != "full":
         model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                              attn_impl=cfg.attn)
@@ -138,16 +154,22 @@ def run(cfg: Config) -> dict:
     # equivalence (imagenet.py:215,316).
     state = create_train_state(
         init_model, jax.random.key(cfg.seed), cfg.image_size, optimizer)
-    state = replicate_state(state, mesh)
-    train_step = make_train_step(model, optimizer, mesh, seq_parallel=use_sp)
-    eval_step = make_eval_step(model, mesh)
+    state_specs = None
+    if use_tp:
+        from imagent_tpu.parallel.tensor_parallel import vit_tp_param_specs
+        state_specs = state_partition_specs(
+            state, vit_tp_param_specs(state.params))
+    state = place_state(state, mesh, state_specs)
+    train_step = make_train_step(model, optimizer, mesh, seq_parallel=use_sp,
+                                 state_specs=state_specs)
+    eval_step = make_eval_step(model, mesh, state_specs)
 
     start_epoch, best_top1, best_top5, best_epoch = 0, 0.0, 0.0, -1
     if cfg.resume:
         restored = ckpt_lib.restore(cfg.ckpt_dir, ckpt_lib.LAST, state)
         if restored is not None:
             state, meta = restored
-            state = replicate_state(state, mesh)
+            state = place_state(state, mesh, state_specs)
             start_epoch = int(meta.get("epoch", -1)) + 1
             best_top1 = float(meta.get("best_top1", 0.0))
             best_top5 = float(meta.get("best_top5", 0.0))

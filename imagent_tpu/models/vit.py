@@ -43,23 +43,44 @@ def _make_attn_fn(attn_impl: str, seq_axis: str | None):
 
 class MultiHeadAttention(nn.Module):
     """MHA with explicit q/k/v/out projections (param layout equivalent to
-    torch's fused in_proj + out_proj)."""
+    torch's fused in_proj + out_proj).
+
+    ``tp_axis`` shards heads Megatron-style: q/k/v are column-parallel
+    (each shard projects onto its local heads), attention runs on local
+    heads with zero communication, and the out projection is row-parallel
+    (one psum). Params are slices of the unsharded tree
+    (``parallel/tensor_parallel.py``)."""
 
     num_heads: int
     dtype: Any = jnp.float32
     attn_impl: str = "full"
     seq_axis: str | None = None
+    tp_axis: str | None = None
 
     @nn.compact
     def __call__(self, x):
+        from imagent_tpu.parallel.tensor_parallel import (
+            _RowDenseGeneral, region_input, tp_size,
+        )
         b, n, d = x.shape
         head_dim = d // self.num_heads
+        heads = self.num_heads
+        if self.tp_axis is not None:
+            tp = tp_size(self.tp_axis)
+            if self.num_heads % tp:
+                raise ValueError(f"{self.num_heads} heads not divisible by "
+                                 f"{self.tp_axis} axis size {tp}")
+            heads = self.num_heads // tp
+            x = region_input(x, self.tp_axis)
         dense = partial(nn.DenseGeneral, dtype=self.dtype,
-                        features=(self.num_heads, head_dim), axis=-1)
+                        features=(heads, head_dim), axis=-1)
         q = dense(name="query")(x)
         k = dense(name="key")(x)
         v = dense(name="value")(x)
         y = _make_attn_fn(self.attn_impl, self.seq_axis)(q, k, v)
+        if self.tp_axis is not None:
+            return _RowDenseGeneral(d, self.tp_axis, dtype=self.dtype,
+                                    name="out")(y)
         return nn.DenseGeneral(features=d, axis=(-2, -1), dtype=self.dtype,
                                name="out")(y)
 
@@ -75,17 +96,34 @@ class EncoderBlock(nn.Module):
     dtype: Any = jnp.float32
     attn_impl: str = "full"
     seq_axis: str | None = None
+    tp_axis: str | None = None
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="ln_1")(x)
         x = x + MultiHeadAttention(
             self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
-            seq_axis=self.seq_axis, name="self_attention")(y)
+            seq_axis=self.seq_axis, tp_axis=self.tp_axis,
+            name="self_attention")(y)
         y = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="ln_2")(x)
-        y = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_0")(y)
+        tp = 1
+        if self.tp_axis is not None:
+            from imagent_tpu.parallel.tensor_parallel import (
+                _RowDense, region_input, tp_size,
+            )
+            tp = tp_size(self.tp_axis)
+            if self.mlp_dim % tp:
+                raise ValueError(f"mlp_dim {self.mlp_dim} not divisible by "
+                                 f"{self.tp_axis} axis size {tp}")
+            y = region_input(y, self.tp_axis)
+        y = nn.Dense(self.mlp_dim // tp, dtype=self.dtype,
+                     name="mlp_0")(y)  # column-parallel when tp > 1
         y = nn.gelu(y, approximate=False)
-        y = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_1")(y)
+        if self.tp_axis is not None:
+            y = _RowDense(x.shape[-1], self.tp_axis, dtype=self.dtype,
+                          name="mlp_1")(y)
+        else:
+            y = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_1")(y)
         return x + y
 
 
@@ -109,8 +147,9 @@ class VisionTransformer(nn.Module):
     num_classes: int = 1000
     dtype: Any = jnp.float32
     gap_readout: bool = False
-    attn_impl: str = "full"       # full | ring | ulysses
+    attn_impl: str = "full"       # full | flash | ring | ulysses
     seq_axis: str | None = None   # mesh axis for sequence parallelism
+    tp_axis: str | None = None    # mesh axis for tensor (head/MLP) sharding
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -148,7 +187,7 @@ class VisionTransformer(nn.Module):
         for i in range(self.num_layers):
             x = EncoderBlock(self.num_heads, self.mlp_dim, dtype=self.dtype,
                              attn_impl=self.attn_impl,
-                             seq_axis=self.seq_axis,
+                             seq_axis=self.seq_axis, tp_axis=self.tp_axis,
                              name=f"encoder_layer_{i}")(x)
         x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="ln")(x)
         if use_cls:

@@ -1,0 +1,148 @@
+"""Megatron-style tensor parallelism over the mesh ``model`` axis.
+
+No reference analogue (the reference is pure DP — SURVEY §2c lists TP as
+"not required; mesh design leaves a model axis available"); this module
+makes that axis first-class for dense compute: attention heads and MLP
+hidden units shard across chips, with exactly two ICI collectives per
+transformer block (one per row-parallel projection), laid out so they
+ride the innermost (fastest) mesh axis.
+
+The two boundary functions are Megatron's ``f``/``g``:
+
+* ``region_input`` (f): identity forward, ``psum`` backward. Placed where
+  a replicated activation enters a parallel region, it makes gradients of
+  everything UPSTREAM (LayerNorm, embeddings, patchify) complete without
+  any tree-wide gradient correction.
+* ``region_output`` (g): ``psum`` forward, identity backward. The
+  row-parallel reduce. Its backward is identity because the incoming
+  cotangent is already replicated across the axis.
+
+Param-tree compatibility: ``_RowDense`` / ``_RowDenseGeneral`` declare
+params named ``kernel``/``bias`` exactly like the ``nn.Dense`` /
+``nn.DenseGeneral`` they replace, so a TP model consumes *slices of the
+same checkpoint tree* the unsharded model initializes — sharding is a
+pure layout choice (``vit_tp_param_specs``), not a different model.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from imagent_tpu.cluster import MODEL_AXIS
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def region_input(x, axis_name: str):
+    """Megatron ``f``: identity fwd; psum bwd over ``axis_name``."""
+    return x
+
+
+def _ri_fwd(x, axis_name):
+    return x, None
+
+
+def _ri_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+region_input.defvjp(_ri_fwd, _ri_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def region_output(x, axis_name: str):
+    """Megatron ``g``: psum fwd over ``axis_name``; identity bwd (the
+    cotangent of the replicated output is itself replicated)."""
+    return lax.psum(x, axis_name)
+
+
+def _ro_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _ro_bwd(axis_name, _, g):
+    return (g,)
+
+
+region_output.defvjp(_ro_fwd, _ro_bwd)
+
+
+class _RowDense(nn.Module):
+    """Row-parallel ``nn.Dense``: local input features × sharded kernel
+    rows → psum → + replicated bias (added once, after the reduce)."""
+
+    features: int
+    axis_name: str
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.zeros,
+                            (x.shape[-1], self.features), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        y = jnp.dot(x, kernel.astype(self.dtype))
+        return region_output(y, self.axis_name) + bias.astype(self.dtype)
+
+
+class _RowDenseGeneral(nn.Module):
+    """Row-parallel ``nn.DenseGeneral(axis=(-2, -1))``: contracts the
+    (local_heads, head_dim) axes against a head-sharded kernel, then
+    reduces across the axis. Param names match DenseGeneral."""
+
+    features: int
+    axis_name: str
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        lh, hd = x.shape[-2], x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.zeros,
+                            (lh, hd, self.features), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        y = jnp.einsum("...hd,hdf->...f", x, kernel.astype(self.dtype))
+        return region_output(y, self.axis_name) + bias.astype(self.dtype)
+
+
+def tp_size(axis_name: str) -> int:
+    """Static axis size (usable at trace time under shard_map)."""
+    return lax.psum(1, axis_name)
+
+
+def vit_tp_param_specs(params, axis: str = MODEL_AXIS):
+    """PartitionSpec tree for a ViT param tree under head/MLP sharding.
+
+    query/key/value: kernel (d, H, hd) → shard H; bias (H, hd) → shard H.
+    out:             kernel (H, hd, d) → shard H; bias replicated.
+    mlp_0:           kernel (d, mlp) → shard mlp; bias (mlp,) → shard.
+    mlp_1:           kernel (mlp, d) → shard mlp; bias replicated.
+    Everything else (LN, patchify, pos embedding, head) replicated.
+    """
+
+    def spec(path, leaf):
+        keys = [p.key for p in path if hasattr(p, "key")]
+        parent = keys[-2] if len(keys) >= 2 else ""
+        name = keys[-1] if keys else ""
+        nd = jnp.ndim(leaf)
+        if parent in ("query", "key", "value"):
+            if name == "kernel":  # (d, H, hd)
+                return P(None, axis, None)
+            return P(axis, None)  # bias (H, hd)
+        if parent == "out" and name == "kernel":  # (H, hd, d)
+            return P(axis, *([None] * (nd - 1)))
+        if parent == "mlp_0":
+            if name == "kernel":  # (d, mlp)
+                return P(None, axis)
+            return P(axis)  # bias (mlp,)
+        if parent == "mlp_1" and name == "kernel":  # (mlp, d)
+            return P(axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

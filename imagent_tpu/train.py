@@ -89,9 +89,32 @@ def create_train_state(model, rng: jax.Array, image_size: int,
     )
 
 
+def state_partition_specs(state: TrainState, params_specs) -> TrainState:
+    """TrainState-shaped tree of PartitionSpecs from a params spec tree
+    (tensor parallelism, ``parallel/tensor_parallel.py``). Optimizer slots
+    inherit their parameter's spec when the state mirrors the param tree
+    (true for the SGD chain: trace slots are params-shaped); anything
+    unrecognized stays replicated."""
+    p_leaves, _ = jax.tree_util.tree_flatten(state.params)
+    s_leaves, _ = jax.tree_util.tree_flatten(params_specs)
+    o_leaves, o_tree = jax.tree_util.tree_flatten(state.opt_state)
+    if ([jnp.shape(x) for x in o_leaves]
+            == [jnp.shape(x) for x in p_leaves]):
+        opt_specs = jax.tree_util.tree_unflatten(o_tree, s_leaves)
+    else:  # unknown optimizer layout: replicate its state
+        opt_specs = jax.tree.map(lambda _: P(), state.opt_state)
+    return TrainState(
+        step=P(),
+        params=params_specs,
+        batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
+        opt_state=opt_specs,
+    )
+
+
 def make_train_step(model, optimizer: optax.GradientTransformation,
                     mesh: Mesh, label_smoothing: float = 0.0,
-                    seq_parallel: bool = False) -> Callable:
+                    seq_parallel: bool = False,
+                    state_specs: TrainState | None = None) -> Callable:
     """Build the jitted SPMD train step.
 
     ``shard_map`` over the ``data`` axis gives each device its batch shard
@@ -145,15 +168,17 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
             batch_stats=new_bs, opt_state=new_opt_state)
         return new_state, metrics
 
+    st = state_specs if state_specs is not None else P()
     sharded = jax.shard_map(
         per_device_step, mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P()),
-        out_specs=(P(), P()),
+        in_specs=(st, P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(st, P()),
         check_vma=False)
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_eval_step(model, mesh: Mesh) -> Callable:
+def make_eval_step(model, mesh: Mesh,
+                   state_specs: TrainState | None = None) -> Callable:
     """Jitted eval step (reference ``validate()``, ``imagenet.py:166-210``).
 
     Takes an explicit per-sample validity ``mask`` so padded remainder
@@ -178,9 +203,10 @@ def make_eval_step(model, mesh: Mesh) -> Callable:
         local = jnp.stack([per_sample.sum(), c1, c5, mask.sum()])
         return lax.psum(local, DATA_AXIS)
 
+    st = state_specs if state_specs is not None else P()
     sharded = jax.shard_map(
         per_device_eval, mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(st, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(),
         check_vma=False)
     return jax.jit(sharded)
@@ -191,6 +217,18 @@ def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
     parameter broadcast (``imagenet.py:316``) done by sharding layout."""
     sharding = NamedSharding(mesh, P())
     return jax.device_put(state, sharding)
+
+
+def place_state(state: TrainState, mesh: Mesh,
+                state_specs: TrainState | None = None) -> TrainState:
+    """Lay a host-side (full) TrainState onto the mesh per spec tree —
+    sharded leaves (tensor parallelism) are split, ``P()`` leaves
+    replicated. With no specs this is ``replicate_state``."""
+    if state_specs is None:
+        return replicate_state(state, mesh)
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state, state_specs)
 
 
 def shard_batch(mesh: Mesh, *arrays):
