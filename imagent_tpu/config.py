@@ -53,6 +53,9 @@ class Config:
     synthetic_size: int = 2048  # images per epoch in synthetic mode
     bf16: bool = True  # bfloat16 compute on the MXU
     warmup_epochs: int = 0  # linear LR warmup (0 = reference behavior)
+    # Micro-batches accumulated per optimizer step inside the compiled
+    # train step: effective global batch = batch_size * data_parallel * K.
+    grad_accum: int = 1
     schedule: str = "step"  # step | cosine
     eval_every: int = 1  # validate every N epochs
     log_every: int = 50  # step-level stdout cadence on process 0
@@ -123,6 +126,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-bf16", dest="bf16", action="store_false",
                    default=True)
     p.add_argument("--warmup-epochs", type=int, default=c.warmup_epochs)
+    p.add_argument("--grad-accum", type=int, default=c.grad_accum,
+                   help="micro-batches per optimizer step (default 1)")
     p.add_argument("--schedule", type=str, default=c.schedule,
                    choices=["step", "cosine"])
     p.add_argument("--eval-every", type=int, default=c.eval_every)

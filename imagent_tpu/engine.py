@@ -101,9 +101,13 @@ def run(cfg: Config) -> dict:
 
     mesh = cluster.make_mesh(cfg.model_parallel)
     n_data = mesh.shape[cluster.DATA_AXIS]
-    global_batch = cfg.batch_size * n_data
+    if cfg.grad_accum < 1:
+        raise ValueError("--grad-accum must be >= 1")
+    global_batch = cfg.batch_size * n_data * cfg.grad_accum
     if is_master:
-        print(f"mesh {dict(mesh.shape)} global_batch {global_batch}",
+        print(f"mesh {dict(mesh.shape)} global_batch {global_batch}"
+              + (f" (grad_accum {cfg.grad_accum})"
+                 if cfg.grad_accum > 1 else ""),
               flush=True)
 
     use_sp = cfg.seq_parallel != "none"
@@ -161,7 +165,8 @@ def run(cfg: Config) -> dict:
             state, vit_tp_param_specs(state.params))
     state = place_state(state, mesh, state_specs)
     train_step = make_train_step(model, optimizer, mesh, seq_parallel=use_sp,
-                                 state_specs=state_specs)
+                                 state_specs=state_specs,
+                                 grad_accum=cfg.grad_accum)
     eval_step = make_eval_step(model, mesh, state_specs)
 
     start_epoch, best_top1, best_top5, best_epoch = 0, 0.0, 0.0, -1

@@ -114,7 +114,8 @@ def state_partition_specs(state: TrainState, params_specs) -> TrainState:
 def make_train_step(model, optimizer: optax.GradientTransformation,
                     mesh: Mesh, label_smoothing: float = 0.0,
                     seq_parallel: bool = False,
-                    state_specs: TrainState | None = None) -> Callable:
+                    state_specs: TrainState | None = None,
+                    grad_accum: int = 1) -> Callable:
     """Build the jitted SPMD train step.
 
     ``shard_map`` over the ``data`` axis gives each device its batch shard
@@ -126,20 +127,62 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     ``metrics`` is a replicated ``[loss_sum, top1_cnt, top5_cnt, n]``
     vector; the host-side meters divide (``AverageMeter`` semantics,
     ``imagenet.py:143-145``) without forcing a device sync.
+
+    ``grad_accum`` splits each device's batch into that many sequential
+    micro-batches inside the compiled step (``lax.scan``): one optimizer
+    update and ONE gradient collective per step regardless of K, trading
+    activation memory for wall-clock — the standard way to reach the
+    reference's global-batch-2048 geometry (``imagenet.py:443``) on few
+    chips. Gradients average over the full effective batch (exact DDP
+    semantics); BatchNorm running stats chain through the micro-batches.
     """
 
-    def per_device_step(state: TrainState, images, labels, lr):
-        def loss_fn(params):
-            logits, mutated = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
-                images, train=True, mutable=["batch_stats"])
-            per_sample = softmax_cross_entropy(logits, labels,
-                                               label_smoothing)
-            return per_sample.mean(), (logits, per_sample,
-                                       mutated["batch_stats"])
+    def loss_fn(params, batch_stats, images, labels):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images, train=True, mutable=["batch_stats"])
+        per_sample = softmax_cross_entropy(logits, labels, label_smoothing)
+        return per_sample.mean(), (logits, per_sample,
+                                   mutated["batch_stats"])
 
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (_, (logits, per_sample, new_bs)), grads = grad_fn(state.params)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def accumulate(params, batch_stats, images, labels):
+        """(grads_mean, metrics_sum, new_batch_stats) over K micro-batches."""
+        if grad_accum <= 1:
+            (_, (logits, per_sample, new_bs)), grads = grad_fn(
+                params, batch_stats, images, labels)
+            c1, c5 = topk_correct(logits, labels)
+            local = jnp.stack([per_sample.sum(), c1, c5,
+                               jnp.float32(labels.shape[0])])
+            return grads, local, new_bs
+
+        images = images.reshape(grad_accum, -1, *images.shape[1:])
+        labels = labels.reshape(grad_accum, -1)
+
+        def micro(carry, xs):
+            bs, grads_acc, metrics_acc = carry
+            im, lb = xs
+            (_, (logits, per_sample, bs)), grads = grad_fn(
+                params, bs, im, lb)
+            c1, c5 = topk_correct(logits, lb)
+            local = jnp.stack([per_sample.sum(), c1, c5,
+                               jnp.float32(lb.shape[0])])
+            grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+            return (bs, grads_acc, metrics_acc + local), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (new_bs, grads_sum, metrics), _ = lax.scan(
+            micro, (batch_stats, zeros, jnp.zeros((4,), jnp.float32)),
+            (images, labels))
+        # mean of per-micro means == mean over the full device batch
+        # (equal micro sizes), keeping DDP's averaging semantics.
+        grads = jax.tree.map(lambda g: g / grad_accum, grads_sum)
+        return grads, metrics, new_bs
+
+    def per_device_step(state: TrainState, images, labels, lr):
+        grads, local, new_bs = accumulate(
+            state.params, state.batch_stats, images, labels)
 
         # DDP gradient averaging (imagenet.py:316) — one fused allreduce.
         grads = pmean_tree(grads, DATA_AXIS)
@@ -158,9 +201,6 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         updates = jax.tree.map(lambda u: -lr * u, updates)
         new_params = optax.apply_updates(state.params, updates)
 
-        c1, c5 = topk_correct(logits, labels)
-        local = jnp.stack([per_sample.sum(), c1, c5,
-                           jnp.float32(labels.shape[0])])
         metrics = lax.psum(local, DATA_AXIS)
 
         new_state = state.replace(
