@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+PIPE_AXIS = "pipe"
 MODEL_AXIS = "model"
 DEFAULT_COORDINATOR_PORT = 29500  # reference's MASTER_PORT (imagenet.py:242)
 
@@ -180,20 +181,25 @@ def rank_banner(senv: SlurmEnv | None) -> str:
 
 
 def make_mesh(model_parallel: int = 1,
-              devices: Sequence[jax.Device] | None = None) -> Mesh:
-    """Build the global 2-D ``(data, model)`` device mesh.
+              devices: Sequence[jax.Device] | None = None,
+              pipeline_parallel: int = 1) -> Mesh:
+    """Build the global 3-D ``(data, pipe, model)`` device mesh.
 
-    Lays the model axis innermost so its collectives ride the
-    fastest ICI links; the data axis spans the remaining chips
-    (the reference's 16-rank DP world, ``imagenet.py:316``).
+    Lays the model axis innermost so its collectives (tensor/sequence
+    parallel psum, all-to-all) ride the fastest ICI links; the pipe axis
+    sits next (single-hop ``ppermute`` per tick); the data axis spans the
+    remaining chips (the reference's 16-rank DP world, ``imagenet.py:316``).
+    Unused axes have size 1, so pure-DP shardings are unchanged.
     """
     devs = np.asarray(devices if devices is not None else jax.devices())
-    if devs.size % model_parallel:
+    per_replica = model_parallel * pipeline_parallel
+    if devs.size % per_replica:
         raise ValueError(
-            f"device count {devs.size} not divisible by "
-            f"model_parallel={model_parallel}")
-    grid = devs.reshape(devs.size // model_parallel, model_parallel)
-    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+            f"device count {devs.size} not divisible by model_parallel"
+            f"={model_parallel} x pipeline_parallel={pipeline_parallel}")
+    grid = devs.reshape(devs.size // per_replica, pipeline_parallel,
+                        model_parallel)
+    return Mesh(grid, (DATA_AXIS, PIPE_AXIS, MODEL_AXIS))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -73,6 +73,25 @@ class Config:
     # Megatron-style tensor parallelism over the model axis (ViT only):
     # heads + MLP hidden shard across chips (parallel/tensor_parallel.py).
     tensor_parallel: bool = False
+    # GPipe pipeline parallelism over the pipe axis (ViT only): encoder
+    # layers split into stages, microbatches streamed via ppermute
+    # (parallel/pipeline.py). Composes with --tensor-parallel (3-D mesh).
+    pipeline_parallel: int = 1
+    microbatches: int = 1  # GPipe microbatches per step (pipeline path)
+    # Mixture-of-Experts (ViT only): every k-th block's MLP becomes a
+    # Switch-routed expert bank (parallel/expert_parallel.py); with
+    # --expert-parallel the experts shard over the model axis (GShard
+    # all_to_all dispatch).
+    moe_every: int = 0
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    expert_parallel: bool = False
+    moe_aux_weight: float = 0.01  # Switch load-balancing loss weight
+    # Capacity groups for the dense (non-EP) MoE path. The dispatch
+    # tensors are [T/G, E, C] per group with C ~ cf*T/(G*E): more groups
+    # = quadratically less dispatch memory. Under --expert-parallel the
+    # group count is the expert-axis size and this is ignored.
+    moe_groups: int = 8
     # Single-chip attention kernel (ViT only): full (XLA einsum) | flash
     # (Pallas fused kernel, ops/flash_attention.py).
     attn: str = "full"
@@ -139,6 +158,21 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["none", "ring", "ulysses"])
     p.add_argument("--tensor-parallel", action="store_true", default=False,
                    help="shard attention heads + MLP over the model axis")
+    p.add_argument("--pipeline-parallel", type=int, default=c.pipeline_parallel,
+                   help="GPipe stages over the pipe mesh axis (ViT only)")
+    p.add_argument("--microbatches", type=int, default=c.microbatches,
+                   help="GPipe microbatches per step (pipeline path)")
+    p.add_argument("--moe-every", type=int, default=c.moe_every,
+                   help="every k-th ViT block uses a MoE MLP (0 = dense)")
+    p.add_argument("--num-experts", type=int, default=c.num_experts)
+    p.add_argument("--capacity-factor", type=float,
+                   default=c.capacity_factor)
+    p.add_argument("--expert-parallel", action="store_true", default=False,
+                   help="shard MoE experts over the model axis (all_to_all)")
+    p.add_argument("--moe-aux-weight", type=float, default=c.moe_aux_weight)
+    p.add_argument("--moe-groups", type=int, default=c.moe_groups,
+                   help="capacity groups on the dense MoE path (dispatch "
+                        "memory scales as 1/groups^2)")
     p.add_argument("--attn", type=str, default=c.attn,
                    choices=["full", "flash"],
                    help="ViT attention kernel (flash = Pallas fused)")

@@ -99,7 +99,8 @@ def run(cfg: Config) -> dict:
     print(cluster.rank_banner(senv), flush=True)
     is_master = jax.process_index() == 0
 
-    mesh = cluster.make_mesh(cfg.model_parallel)
+    mesh = cluster.make_mesh(cfg.model_parallel,
+                             pipeline_parallel=cfg.pipeline_parallel)
     n_data = mesh.shape[cluster.DATA_AXIS]
     if cfg.grad_accum < 1:
         raise ValueError("--grad-accum must be >= 1")
@@ -128,6 +129,21 @@ def run(cfg: Config) -> dict:
         raise ValueError(
             "--tensor-parallel and --seq-parallel both consume the model "
             "axis; pick one")
+    use_pp = cfg.pipeline_parallel > 1
+    if use_pp and not cfg.arch.startswith("vit"):
+        raise ValueError("--pipeline-parallel requires a ViT arch")
+    if use_pp and use_sp:
+        raise ValueError("--pipeline-parallel with --seq-parallel is not "
+                         "supported; compose pp with --tensor-parallel")
+    use_ep = cfg.expert_parallel
+    if cfg.moe_every and not cfg.arch.startswith("vit"):
+        raise ValueError("--moe-every requires a ViT arch")
+    if cfg.moe_every and (use_sp or use_pp or use_tp):
+        raise ValueError("MoE composes with data parallelism (and "
+                         "--expert-parallel); not with sp/pp/tp")
+    if use_ep and (not cfg.moe_every or cfg.model_parallel < 2):
+        raise ValueError("--expert-parallel requires --moe-every > 0 and "
+                         "--model-parallel >= 2")
 
     train_loader, val_loader = make_loaders(
         cfg, jax.process_index(), jax.process_count(), global_batch)
@@ -139,6 +155,28 @@ def run(cfg: Config) -> dict:
         # Same param tree, no mesh-axis ops — usable for host-side init.
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                                   gap_readout=True)
+    elif cfg.moe_every:
+        moe_kw = dict(moe_every=cfg.moe_every, num_experts=cfg.num_experts,
+                      capacity_factor=cfg.capacity_factor,
+                      moe_groups=cfg.moe_groups)
+        model = create_model(
+            cfg.arch, cfg.num_classes, cfg.bf16, attn_impl=cfg.attn,
+            expert_axis=cluster.MODEL_AXIS if use_ep else None, **moe_kw)
+        # Host-side init twin: same param tree; EP consumes slices of it.
+        # groups=1 — params don't depend on the capacity grouping, and
+        # the init batch (2 images) need not divide the run's groups.
+        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                                  attn_impl=cfg.attn,
+                                  **{**moe_kw, "moe_groups": 1})
+    elif use_pp:
+        model = create_model(
+            cfg.arch, cfg.num_classes, cfg.bf16, attn_impl=cfg.attn,
+            pipe_axis=cluster.PIPE_AXIS, microbatches=cfg.microbatches,
+            tp_axis=cluster.MODEL_AXIS if use_tp else None)
+        # Host-side init uses the layer-stacked pipe-free twin (same
+        # param tree, parallel/pipeline.py).
+        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                                  attn_impl=cfg.attn, stacked=True)
     elif use_tp:
         model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                              attn_impl=cfg.attn, tp_axis=cluster.MODEL_AXIS)
@@ -159,14 +197,28 @@ def run(cfg: Config) -> dict:
     state = create_train_state(
         init_model, jax.random.key(cfg.seed), cfg.image_size, optimizer)
     state_specs = None
-    if use_tp:
+    if use_ep:
+        from imagent_tpu.parallel.expert_parallel import vit_moe_param_specs
+        state_specs = state_partition_specs(
+            state, vit_moe_param_specs(state.params))
+    elif use_pp:
+        from imagent_tpu.parallel.pipeline import vit_pp_param_specs
+        state_specs = state_partition_specs(
+            state, vit_pp_param_specs(
+                state.params,
+                tp_axis=cluster.MODEL_AXIS if use_tp else None))
+    elif use_tp:
         from imagent_tpu.parallel.tensor_parallel import vit_tp_param_specs
         state_specs = state_partition_specs(
             state, vit_tp_param_specs(state.params))
     state = place_state(state, mesh, state_specs)
     train_step = make_train_step(model, optimizer, mesh, seq_parallel=use_sp,
                                  state_specs=state_specs,
-                                 grad_accum=cfg.grad_accum)
+                                 grad_accum=cfg.grad_accum,
+                                 pipe_axis=cluster.PIPE_AXIS if use_pp
+                                 else None,
+                                 expert_parallel=use_ep,
+                                 aux_loss_weight=cfg.moe_aux_weight)
     eval_step = make_eval_step(model, mesh, state_specs)
 
     start_epoch, best_top1, best_top5, best_epoch = 0, 0.0, 0.0, -1

@@ -89,7 +89,9 @@ class EncoderBlock(nn.Module):
     """Pre-LN transformer block: x += MHA(LN(x)); x += MLP(LN(x)).
 
     Every non-attention op is per-token, so under sequence parallelism the
-    block runs unchanged on each shard's token slice."""
+    block runs unchanged on each shard's token slice. With ``moe`` set the
+    MLP is a Mixture-of-Experts (``parallel/expert_parallel.py``), with
+    experts sharded over ``expert_axis`` when given."""
 
     num_heads: int
     mlp_dim: int
@@ -97,6 +99,11 @@ class EncoderBlock(nn.Module):
     attn_impl: str = "full"
     seq_axis: str | None = None
     tp_axis: str | None = None
+    moe: bool = False
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    moe_groups: int = 1
+    expert_axis: str | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -106,6 +113,16 @@ class EncoderBlock(nn.Module):
             seq_axis=self.seq_axis, tp_axis=self.tp_axis,
             name="self_attention")(y)
         y = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="ln_2")(x)
+        if self.moe:
+            if self.tp_axis is not None:
+                raise ValueError("MoE and tensor parallelism both consume "
+                                 "the model axis; pick one")
+            from imagent_tpu.parallel.expert_parallel import MoEMLP
+            return x + MoEMLP(
+                self.mlp_dim, num_experts=self.num_experts,
+                capacity_factor=self.capacity_factor,
+                groups=self.moe_groups, expert_axis=self.expert_axis,
+                dtype=self.dtype, name="moe")(y)
         tp = 1
         if self.tp_axis is not None:
             from imagent_tpu.parallel.tensor_parallel import (
@@ -150,6 +167,16 @@ class VisionTransformer(nn.Module):
     attn_impl: str = "full"       # full | flash | ring | ulysses
     seq_axis: str | None = None   # mesh axis for sequence parallelism
     tp_axis: str | None = None    # mesh axis for tensor (head/MLP) sharding
+    pipe_axis: str | None = None  # mesh axis for pipeline parallelism
+    microbatches: int = 1         # GPipe microbatches (pipeline path)
+    stacked: bool = False         # layer-stacked encoder params (nn.scan);
+    # implied by pipe_axis — the pipe-free twin with stacked=True has the
+    # SAME param tree as the pipelined model (host init / numerical ref).
+    moe_every: int = 0            # every k-th block's MLP is MoE (0 = dense)
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    moe_groups: int = 1           # capacity groups in the unsharded twin
+    expert_axis: str | None = None  # mesh axis for expert parallelism
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -184,11 +211,31 @@ class VisionTransformer(nn.Module):
             idx = lax.axis_index(self.seq_axis)
             x = lax.dynamic_slice_in_dim(x, idx * n_local, n_local, axis=1)
 
-        for i in range(self.num_layers):
-            x = EncoderBlock(self.num_heads, self.mlp_dim, dtype=self.dtype,
-                             attn_impl=self.attn_impl,
-                             seq_axis=self.seq_axis, tp_axis=self.tp_axis,
-                             name=f"encoder_layer_{i}")(x)
+        if self.stacked or self.pipe_axis is not None:
+            if self.moe_every:
+                raise ValueError(
+                    "MoE is not supported on the stacked/pipelined encoder "
+                    "(heterogeneous layers break the nn.scan stack)")
+            from imagent_tpu.parallel.pipeline import Pipeline
+            body = partial(EncoderBlock, self.num_heads, self.mlp_dim,
+                           dtype=self.dtype, attn_impl=self.attn_impl,
+                           seq_axis=self.seq_axis, tp_axis=self.tp_axis,
+                           name="block")
+            x = Pipeline(body=body, num_layers=self.num_layers,
+                         pipe_axis=self.pipe_axis,
+                         microbatches=self.microbatches, name="encoder")(x)
+        else:
+            for i in range(self.num_layers):
+                moe = (self.moe_every > 0
+                       and i % self.moe_every == self.moe_every - 1)
+                x = EncoderBlock(self.num_heads, self.mlp_dim,
+                                 dtype=self.dtype, attn_impl=self.attn_impl,
+                                 seq_axis=self.seq_axis, tp_axis=self.tp_axis,
+                                 moe=moe, num_experts=self.num_experts,
+                                 capacity_factor=self.capacity_factor,
+                                 moe_groups=self.moe_groups,
+                                 expert_axis=self.expert_axis,
+                                 name=f"encoder_layer_{i}")(x)
         x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="ln")(x)
         if use_cls:
             pooled = x[:, 0]

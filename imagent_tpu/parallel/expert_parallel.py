@@ -1,0 +1,190 @@
+"""Mixture-of-Experts with expert parallelism over the mesh ``model`` axis.
+
+No reference analogue (the reference is a dense ResNet, SURVEY §2c lists
+EP as "not required"); this module adds the MoE model family and makes
+expert placement a first-class sharding, designed TPU-first:
+
+* **Einsum dispatch, not gather/scatter.** Routing is the GShard/Switch
+  one-hot formulation: a ``[tokens, experts, capacity]`` dispatch tensor
+  contracted with the token matrix — three big static-shape einsums that
+  map straight onto the MXU. No sorting, no ragged shapes, no
+  data-dependent control flow (XLA requirement).
+* **Group-wise capacity.** Tokens are processed in G groups, each with
+  ``capacity = round(cf * T_group / E)`` slots per expert; overflow
+  tokens fall through the residual connection (standard Switch
+  behavior). Under expert parallelism each shard's token slice IS one
+  group, so the sharded and unsharded models are numerically identical
+  (the unsharded twin evaluates the same G groups in one einsum).
+* **all_to_all over ICI.** With ``expert_axis`` set, each shard slices
+  its token group (like sequence parallelism), computes the dispatch for
+  the full expert set, and two ``lax.all_to_all`` exchanges move the
+  ``[E, C, D]`` slot tensor to expert owners and back — the canonical
+  GShard pattern; the return path ends with a tiled ``all_gather`` so
+  downstream (dense) layers see the replicated activation again.
+* **Switch load-balancing aux loss** (``E * sum_e f_e * P_e``), sown into
+  the ``intermediates`` collection; the train step adds
+  ``aux_weight * mean`` to the objective (``train.make_train_step``).
+
+Gradient semantics: the layer output is replicated over ``expert_axis``
+while expert params shard over it, so every shard seeds an identical
+loss and per-shard grads come out ``ep x`` the true partials; the train
+step applies ``normalize_region_grads`` (``parallel/pipeline.py``) —
+``g/ep`` for expert leaves, ``pmean`` for replicated ones.
+
+Param-tree compatibility: both modes declare ``router`` ``[D, E]`` and
+expert stacks ``wi [E, D, H]`` / ``wo [E, H, D]`` (local slices thereof
+under shard_map), so the EP model consumes slices of the same checkpoint
+tree the unsharded model initializes — sharding is a pure layout choice
+(``vit_moe_param_specs``), exactly like TP/PP.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from imagent_tpu.cluster import MODEL_AXIS
+
+
+def _dispatch_combine(gates: jnp.ndarray, capacity: int):
+    """Top-1 (Switch) dispatch/combine tensors for one token group.
+
+    gates: [T, E] softmax router probabilities, float32. All position
+    arithmetic stays in float32 regardless of the model dtype: a bf16
+    cumsum cannot represent queue positions above 256, which would
+    silently collapse distinct tokens into one capacity slot at
+    realistic token counts.
+    Returns (dispatch [T, E, C] one-hot, combine [T, E, C] weighted),
+    float32 — caller casts for the MXU einsums (0/1 and gate weights
+    are bf16-safe values).
+    A token's slot in its expert's queue is a cumsum over the one-hot
+    assignment (arrival order); tokens past ``capacity`` get a zero
+    dispatch row and ride the residual connection.
+    """
+    gates = gates.astype(jnp.float32)
+    idx = jnp.argmax(gates, axis=-1)                      # [T]
+    prob = jnp.max(gates, axis=-1)                        # [T]
+    onehot = jax.nn.one_hot(idx, gates.shape[-1], dtype=jnp.float32)
+    pos = jnp.cumsum(onehot, axis=0) * onehot             # [T, E], 1-based
+    keep = ((pos > 0) & (pos <= capacity)).astype(jnp.float32)
+    slot = jnp.clip(pos - 1, 0, capacity - 1).astype(jnp.int32)
+    disp = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)  # [T, E, C]
+    disp = disp * keep[..., None]
+    combine = prob[:, None, None] * disp
+    return disp, combine
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MoE replacement for the transformer MLP (tokens in,
+    tokens out; caller owns the residual connection).
+
+    ``expert_axis=None``: dense evaluation of all experts in G =
+    ``groups`` capacity groups (the host-init / numerical-reference twin).
+    ``expert_axis`` set (inside shard_map): experts shard over the axis;
+    the shard's token slice is its group; all_to_all dispatch/return.
+    """
+
+    mlp_dim: int
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    groups: int = 1
+    expert_axis: str | None = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, n, d = x.shape
+        e = self.num_experts
+        ep = 1 if self.expert_axis is None else lax.psum(1, self.expert_axis)
+        groups = ep if self.expert_axis is not None else self.groups
+        if (b * n) % groups:
+            raise ValueError(f"{b * n} tokens not divisible by "
+                             f"{groups} capacity groups")
+        if e % ep:
+            raise ValueError(f"{e} experts not divisible by expert axis "
+                             f"size {ep}")
+        e_local = e // ep
+        t_group = (b * n) // groups
+        capacity = max(1, int(self.capacity_factor * t_group / e + 0.5))
+
+        router = self.param("router", nn.initializers.normal(stddev=0.02),
+                            (d, e), jnp.float32)
+        # Under shard_map the stored value is the shard's slice, so the
+        # declared (init) shape uses the LOCAL expert count — same
+        # convention as the TP modules (parallel/tensor_parallel.py).
+        wi = self.param("wi", nn.initializers.lecun_normal(),
+                        (e_local, d, self.mlp_dim),
+                        jnp.float32).astype(self.dtype)
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (e_local, self.mlp_dim, d),
+                        jnp.float32).astype(self.dtype)
+
+        tokens = x.reshape(b * n, d)
+
+        def gate(tok):
+            """Router probs (float32) + Switch aux loss for one group."""
+            logits = jnp.dot(tok.astype(jnp.float32), router)
+            g = jax.nn.softmax(logits, axis=-1)
+            frac = jnp.mean(
+                jax.nn.one_hot(jnp.argmax(g, -1), e, dtype=jnp.float32), 0)
+            aux = e * jnp.sum(frac * jnp.mean(g, axis=0))
+            return g, aux
+
+        if self.expert_axis is None:
+            grp = tokens.reshape(groups, t_group, d)
+            gates, aux = jax.vmap(gate)(grp)
+            disp, comb = jax.vmap(
+                lambda gg: _dispatch_combine(gg, capacity))(gates)
+            disp, comb = disp.astype(self.dtype), comb.astype(self.dtype)
+            ein = jnp.einsum("gtd,gtec->gecd", grp, disp)
+            h = nn.gelu(jnp.einsum("gecd,edh->gech", ein, wi),
+                        approximate=False)
+            out = jnp.einsum("gech,ehd->gecd", h, wo)
+            y = jnp.einsum("gecd,gtec->gtd", out, comb)
+            self.sow("intermediates", "moe_aux_loss", jnp.mean(aux))
+            return y.reshape(b, n, d)
+
+        # ---- expert-parallel path (inside shard_map) ----
+        shard = lax.axis_index(self.expert_axis)
+        local = lax.dynamic_slice_in_dim(tokens, shard * t_group, t_group, 0)
+        gates, aux = gate(local)
+        disp, comb = _dispatch_combine(gates, capacity)      # [T, E, C]
+        disp, comb = disp.astype(self.dtype), comb.astype(self.dtype)
+        ein = jnp.einsum("td,tec->ecd", local, disp)         # [E, C, D]
+        # Route slot tensors to their expert's owner shard: split the
+        # expert dim by owner, exchange over the axis (one ICI a2a). The
+        # leading dim is reinterpreted owner -> source group.
+        ein = ein.reshape(ep, e_local, capacity, d)
+        ein = lax.all_to_all(ein, self.expert_axis, split_axis=0,
+                             concat_axis=0)                  # [G, El, C, D]
+        h = nn.gelu(jnp.einsum("gecd,edh->gech", ein, wi),
+                    approximate=False)
+        out = jnp.einsum("gech,ehd->gecd", h, wo)            # [G, El, C, D]
+        out = lax.all_to_all(out, self.expert_axis, split_axis=0,
+                             concat_axis=0)                  # back at source
+        out = out.reshape(e, capacity, d)
+        y = jnp.einsum("ecd,tec->td", out, comb)             # [T, D]
+        y = lax.all_gather(y, self.expert_axis, axis=0, tiled=True)
+        self.sow("intermediates", "moe_aux_loss",
+                 lax.pmean(aux, self.expert_axis))
+        return y.reshape(b, n, d)
+
+
+def vit_moe_param_specs(params, expert_axis: str = MODEL_AXIS):
+    """PartitionSpec tree for a MoE ViT: expert-stacked leaves (wi/wo)
+    shard dim 0 over ``expert_axis``; router and everything else
+    replicated."""
+
+    def spec(path, leaf):
+        keys = [p.key for p in path if hasattr(p, "key")]
+        name = keys[-1] if keys else ""
+        if name in ("wi", "wo"):
+            return P(expert_axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

@@ -115,7 +115,10 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
                     mesh: Mesh, label_smoothing: float = 0.0,
                     seq_parallel: bool = False,
                     state_specs: TrainState | None = None,
-                    grad_accum: int = 1) -> Callable:
+                    grad_accum: int = 1,
+                    pipe_axis: str | None = None,
+                    expert_parallel: bool = False,
+                    aux_loss_weight: float = 0.01) -> Callable:
     """Build the jitted SPMD train step.
 
     ``shard_map`` over the ``data`` axis gives each device its batch shard
@@ -135,15 +138,39 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     reference's global-batch-2048 geometry (``imagenet.py:443``) on few
     chips. Gradients average over the full effective batch (exact DDP
     semantics); BatchNorm running stats chain through the micro-batches.
+
+    ``pipe_axis``: set (with matching ``state_specs``) for a
+    pipeline-parallel model (``parallel/pipeline.py``) — applies the
+    per-shard gradient normalization (``normalize_region_grads``).
+
+    ``expert_parallel``: set (with matching ``state_specs``) for a MoE
+    model with experts sharded over the model axis
+    (``parallel/expert_parallel.py``) — same normalization, model axis.
+
+    Models that sow auxiliary losses into the ``intermediates``
+    collection (the MoE router's load-balancing term) contribute
+    ``aux_loss_weight x`` their mean to the objective; reported metrics
+    remain pure cross-entropy.
     """
+    if (pipe_axis is not None or expert_parallel) and state_specs is None:
+        raise ValueError("pipe_axis / expert_parallel require state_specs "
+                         "(the sharded param layout)")
+    # Axes over which the model's output is replicated while some params
+    # shard (pipeline stages / MoE experts) — each needs grad fixup.
+    region_axes = ([pipe_axis] if pipe_axis is not None else []) + \
+        ([MODEL_AXIS] if expert_parallel else [])
 
     def loss_fn(params, batch_stats, images, labels):
         logits, mutated = model.apply(
             {"params": params, "batch_stats": batch_stats},
-            images, train=True, mutable=["batch_stats"])
+            images, train=True, mutable=["batch_stats", "intermediates"])
         per_sample = softmax_cross_entropy(logits, labels, label_smoothing)
-        return per_sample.mean(), (logits, per_sample,
-                                   mutated["batch_stats"])
+        loss = per_sample.mean()
+        aux = jax.tree_util.tree_leaves(mutated.get("intermediates", {}))
+        if aux:  # static: sown aux losses (MoE load balancing)
+            loss = loss + aux_loss_weight * (sum(aux) / len(aux))
+        return loss, (logits, per_sample,
+                      mutated.get("batch_stats", {}))
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
@@ -195,6 +222,9 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
             # and sums the per-shard partial contributions:
             #   (1/P) * sum_i P * dL/dp_i = sum_i dL/dp_i = dL/dparams.
             grads = pmean_tree(grads, MODEL_AXIS)
+        for axis in region_axes:
+            from imagent_tpu.parallel.pipeline import normalize_region_grads
+            grads = normalize_region_grads(grads, state_specs.params, axis)
 
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params)

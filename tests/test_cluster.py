@@ -59,10 +59,12 @@ def test_parse_slurm_env_rank0_is_coordinator():
 
 def test_make_mesh_shapes():
     m = make_mesh(model_parallel=1)
-    assert m.devices.shape == (8, 1)
-    assert m.axis_names == ("data", "model")
+    assert m.devices.shape == (8, 1, 1)
+    assert m.axis_names == ("data", "pipe", "model")
     m2 = make_mesh(model_parallel=2)
-    assert m2.devices.shape == (4, 2)
+    assert m2.devices.shape == (4, 1, 2)
+    m3 = make_mesh(model_parallel=2, pipeline_parallel=2)
+    assert m3.devices.shape == (2, 2, 2)
 
 
 def test_make_mesh_indivisible():

@@ -1,0 +1,172 @@
+"""Expert parallelism exactness: a MoE ViT with experts sharded over the
+model axis (all_to_all dispatch, ``parallel/expert_parallel.py``) must
+match the unsharded MoE twin evaluated with the same capacity groups —
+the EP analogue of the DDP-equivalence invariant (SURVEY §4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from imagent_tpu.cluster import MODEL_AXIS, make_mesh
+from imagent_tpu.models.vit import VisionTransformer
+from imagent_tpu.parallel.expert_parallel import vit_moe_param_specs
+from imagent_tpu.train import (
+    create_train_state, make_eval_step, make_optimizer, make_train_step,
+    place_state, replicate_state, shard_batch, state_partition_specs,
+)
+
+MOE = dict(moe_every=2, num_experts=8, capacity_factor=1.25)
+TINY = dict(patch_size=8, hidden_dim=32, num_layers=4, num_heads=4,
+            mlp_dim=64, num_classes=8, **MOE)
+SIZE = 32
+BATCH = 16
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    images = rng.normal(size=(BATCH, SIZE, SIZE, 3)).astype(np.float32)
+    labels = rng.integers(0, 8, size=(BATCH,)).astype(np.int32)
+    return images, labels
+
+
+def _ref_step(data, groups):
+    """Single-device MoE reference with the matching capacity grouping
+    (full-batch flatten split into dp x ep contiguous groups)."""
+    images, labels = data
+    mesh = make_mesh(model_parallel=1, devices=jax.devices()[:1])
+    model = VisionTransformer(**TINY, moe_groups=groups)
+    init_model = VisionTransformer(**TINY)  # params don't depend on groups
+    opt = make_optimizer()
+    state = replicate_state(
+        create_train_state(init_model, jax.random.key(0), SIZE, opt), mesh)
+    step = make_train_step(model, opt, mesh)
+    gi, gl = shard_batch(mesh, images, labels)
+    new_state, metrics = step(state, gi, gl, np.float32(0.1))
+    return jax.device_get(new_state), np.asarray(metrics)
+
+
+@pytest.mark.parametrize("ep", [2, 4])
+def test_ep_step_matches_unsharded(data, ep):
+    images, labels = data
+    dp = 8 // ep
+    ref_state, ref_metrics = _ref_step(data, groups=dp * ep)
+
+    mesh = make_mesh(model_parallel=ep)
+    model_ep = VisionTransformer(**TINY, expert_axis=MODEL_AXIS)
+    init_model = VisionTransformer(**TINY)
+    opt = make_optimizer()
+    state0 = create_train_state(init_model, jax.random.key(0), SIZE, opt)
+    specs = state_partition_specs(state0, vit_moe_param_specs(state0.params))
+    state0 = place_state(state0, mesh, specs)
+    step = make_train_step(model_ep, opt, mesh, state_specs=specs,
+                           expert_parallel=True)
+
+    gi, gl = shard_batch(mesh, images, labels)
+    new_state, metrics = step(state0, gi, gl, np.float32(0.1))
+    np.testing.assert_allclose(np.asarray(metrics), ref_metrics,
+                               rtol=1e-4, atol=1e-4)
+    got = jax.device_get(new_state)
+    flat_ref = jax.tree_util.tree_flatten_with_path(ref_state.params)[0]
+    flat_got = jax.tree_util.tree_flatten_with_path(got.params)[0]
+    for (path, a), (_, b) in zip(flat_ref, flat_got):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=2e-4, atol=2e-4,
+            err_msg=jax.tree_util.keystr(path))
+
+
+def test_ep_eval_matches_unsharded(data):
+    images, labels = data
+    ep = 4
+    mesh1 = make_mesh(model_parallel=1, devices=jax.devices()[:1])
+    model = VisionTransformer(**TINY, moe_groups=2 * ep)
+    opt = make_optimizer()
+    state = create_train_state(VisionTransformer(**TINY),
+                               jax.random.key(0), SIZE, opt)
+    ref_eval = make_eval_step(model, mesh1)
+    mask = np.ones((BATCH,), np.float32)
+    gi, gl, gm = shard_batch(mesh1, images, labels, mask)
+    want = np.asarray(ref_eval(replicate_state(state, mesh1), gi, gl, gm))
+
+    mesh = make_mesh(model_parallel=ep)
+    model_ep = VisionTransformer(**TINY, expert_axis=MODEL_AXIS)
+    specs = state_partition_specs(state, vit_moe_param_specs(state.params))
+    state_ep = place_state(state, mesh, specs)
+    ep_eval = make_eval_step(model_ep, mesh, specs)
+    gi, gl, gm = shard_batch(mesh, images, labels, mask)
+    got = np.asarray(ep_eval(state_ep, gi, gl, gm))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_moe_aux_loss_sown(data):
+    """The Switch load-balancing loss is sown and enters the objective:
+    training with aux_loss_weight=0 vs >0 must diverge."""
+    images, labels = data
+    mesh = make_mesh(model_parallel=1, devices=jax.devices()[:1])
+    model = VisionTransformer(**TINY)
+    opt = make_optimizer()
+    # Host copy: the train step donates its input state, so each loop
+    # iteration must replicate from fresh (non-aliased) buffers.
+    state0 = jax.device_get(
+        create_train_state(model, jax.random.key(0), SIZE, opt))
+    gi, gl = shard_batch(mesh, images, labels)
+
+    outs = []
+    for w in (0.0, 1.0):
+        state = replicate_state(state0, mesh)
+        step = make_train_step(model, opt, mesh, aux_loss_weight=w)
+        new_state, _ = step(state, gi, gl, np.float32(0.1))
+        outs.append(jax.device_get(new_state).params)
+    router_a = jax.tree_util.tree_leaves(outs[0])
+    router_b = jax.tree_util.tree_leaves(outs[1])
+    assert any(not np.allclose(a, b) for a, b in zip(router_a, router_b))
+
+
+def test_moe_param_count_scales_with_experts():
+    a = VisionTransformer(**{**TINY, "num_experts": 4})
+    b = VisionTransformer(**{**TINY, "num_experts": 8})
+    x = np.zeros((2, SIZE, SIZE, 3), np.float32)
+    na = sum(v.size for v in jax.tree_util.tree_leaves(
+        a.init(jax.random.key(0), x, train=False)))
+    nb = sum(v.size for v in jax.tree_util.tree_leaves(
+        b.init(jax.random.key(0), x, train=False)))
+    assert nb > na  # expert stacks grew
+
+
+def test_dispatch_slot_uniqueness_large_bf16():
+    """Regression: queue positions are computed in float32 even when the
+    router runs in bf16 — a bf16 cumsum cannot count past 256, silently
+    assigning many tokens to the same capacity slot. Each (expert, slot)
+    must receive at most ONE token."""
+    import jax.numpy as jnp
+
+    from imagent_tpu.parallel.expert_parallel import _dispatch_combine
+
+    rng = np.random.default_rng(3)
+    t, e = 2000, 4
+    gates = jax.nn.softmax(
+        jnp.asarray(rng.normal(size=(t, e)), jnp.bfloat16), axis=-1)
+    capacity = t  # ample: nothing should be dropped for capacity
+    disp, _ = _dispatch_combine(gates, capacity)
+    per_slot = np.asarray(disp.sum(axis=0))  # [E, C]
+    assert per_slot.max() <= 1.0 + 1e-6, per_slot.max()
+    assert per_slot.sum() == t  # every token dispatched exactly once
+
+
+def test_ep_expert_divisibility_fails_loudly():
+    mesh = make_mesh(model_parallel=8)
+    model = VisionTransformer(**{**TINY, "num_experts": 4},
+                              expert_axis=MODEL_AXIS)
+    init_model = VisionTransformer(**{**TINY, "num_experts": 4})
+    opt = make_optimizer()
+    state = create_train_state(init_model, jax.random.key(0), SIZE, opt)
+    specs = state_partition_specs(state, vit_moe_param_specs(state.params))
+    with pytest.raises(ValueError, match="divisible"):
+        state = place_state(state, mesh, specs)
+        step = make_train_step(model, opt, mesh, state_specs=specs,
+                               expert_parallel=True)
+        rng = np.random.default_rng(0)
+        gi, gl = shard_batch(
+            mesh, rng.normal(size=(8, SIZE, SIZE, 3)).astype(np.float32),
+            np.zeros((8,), np.int32))
+        step(state, gi, gl, np.float32(0.1))
