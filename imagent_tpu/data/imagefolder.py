@@ -200,6 +200,9 @@ class ImageFolderLoader:
             images = (np.stack(imgs) if imgs else np.zeros(
                 (0, self.cfg.image_size, self.cfg.image_size, 3), np.float32))
         labels = self.labels[valid].astype(np.int32)
+        if self.cfg.input_bf16:
+            import ml_dtypes
+            images = images.astype(ml_dtypes.bfloat16)
         return pad_batch(images, labels, self.local_rows)
 
     def epoch(self, epoch: int) -> Iterator[Batch]:

@@ -71,4 +71,7 @@ class SyntheticLoader:
                     np.random.default_rng(cfg.seed * 1000003 + int(r) + off))
                 for l, r in zip(labels, valid)]) if len(valid) else np.zeros(
                     (0, cfg.image_size, cfg.image_size, 3), np.float32)
+            if cfg.input_bf16:
+                import ml_dtypes
+                images = images.astype(ml_dtypes.bfloat16)
             yield pad_batch(images, labels, self.local_rows)

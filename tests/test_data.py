@@ -121,3 +121,20 @@ def test_shard_indices_equal_batches_across_processes():
             seen.extend([r for r in b if r != PAD_ROW])
     assert counts == [2, 2]  # equal! (naive p::P split gives [2, 1])
     assert sorted(seen) == list(range(9))  # all samples exactly once
+
+
+def test_input_bf16_batches():
+    """--input-bf16: loaders emit bfloat16 image batches (halved H2D);
+    labels/mask dtypes unchanged."""
+    import ml_dtypes
+
+    from imagent_tpu.config import Config
+    from imagent_tpu.data.synthetic import SyntheticLoader
+
+    cfg = Config(dataset="synthetic", synthetic_size=16, image_size=8,
+                 num_classes=4, batch_size=4, input_bf16=True)
+    loader = SyntheticLoader(cfg, 0, 1, global_batch=8, train=True)
+    batch = next(iter(loader.epoch(0)))
+    assert batch.images.dtype == ml_dtypes.bfloat16
+    assert batch.labels.dtype == np.int32
+    assert batch.mask.dtype == np.float32
