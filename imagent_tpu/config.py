@@ -92,6 +92,14 @@ class Config:
     capacity_factor: float = 1.25
     expert_parallel: bool = False
     moe_aux_weight: float = 0.01  # Switch load-balancing loss weight
+    # FSDP (ZeRO-3): params + momentum fully sharded over the data axis
+    # via the XLA SPMD partitioner (parallel/fsdp.py) — plain jit with
+    # shardings, XLA inserts per-layer all-gathers/reduce-scatters.
+    fsdp: bool = False
+    # ZeRO-1: shard the SGD momentum buffer over the data axis
+    # (parallel/zero.py) — 1/dp optimizer memory per chip, numerically
+    # identical updates. Data-parallel path only.
+    zero1: bool = False
     # Capacity groups for the dense (non-EP) MoE path. The dispatch
     # tensors are [T/G, E, C] per group with C ~ cf*T/(G*E): more groups
     # = quadratically less dispatch memory. Under --expert-parallel the
@@ -177,6 +185,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--expert-parallel", action="store_true", default=False,
                    help="shard MoE experts over the model axis (all_to_all)")
     p.add_argument("--moe-aux-weight", type=float, default=c.moe_aux_weight)
+    p.add_argument("--fsdp", action="store_true", default=False,
+                   help="fully shard params+optimizer over the data axis "
+                        "(XLA SPMD partitioner)")
+    p.add_argument("--zero1", action="store_true", default=False,
+                   help="shard optimizer state over the data axis (ZeRO-1)")
     p.add_argument("--moe-groups", type=int, default=c.moe_groups,
                    help="capacity groups on the dense MoE path (dispatch "
                         "memory scales as 1/groups^2)")

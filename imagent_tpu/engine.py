@@ -21,6 +21,7 @@ the reference's ``torch.cuda.synchronize()`` every step
 
 from __future__ import annotations
 
+import signal
 import time
 
 import jax
@@ -41,6 +42,36 @@ from imagent_tpu.utils.logging import TrainLogger
 from imagent_tpu.utils.metrics import AverageMeter
 
 
+class PreemptionGuard:
+    """Graceful-shutdown aux subsystem (absent in the reference: a rank
+    failure or walltime kill loses everything since epoch 0 — SURVEY §5
+    "Failure detection").
+
+    Catches SIGTERM and SIGUSR1 (Slurm's ``--signal`` pre-kill warning;
+    Cloud TPU preemption notice) and raises a flag; the epoch loop
+    checkpoints LAST and exits cleanly so ``--resume`` continues from the
+    interrupted epoch. Multi-host note: Slurm delivers the signal to
+    every task in the step, so all processes reach the collective
+    checkpoint save together.
+    """
+
+    def __init__(self):
+        self.requested = False
+        for sig in (signal.SIGTERM, getattr(signal, "SIGUSR1", None)):
+            if sig is None:
+                continue
+            try:
+                signal.signal(sig, self._on_signal)
+            except ValueError:  # not on the main thread (e.g. tests)
+                pass
+
+    def _on_signal(self, signum, frame):
+        self.requested = True
+
+    def __call__(self) -> bool:
+        return self.requested
+
+
 def _finalize(metric_buf: list) -> dict:
     """Sum per-step [loss_sum, top1, top5, n] vectors → epoch averages.
     One host sync per epoch (not per step)."""
@@ -53,20 +84,61 @@ def _finalize(metric_buf: list) -> dict:
             "top5": c5 * 100.0 / n, "n": int(n)}
 
 
+def _stop_agreed(stop_check, step_i: int) -> bool:
+    """Preemption decision all processes agree on.
+
+    Single-host: poll every step. Multi-host: polling per-process could
+    desynchronize the pod (one process enters the collective checkpoint
+    save while another dispatches one more train_step — mismatched
+    collectives hang). Instead, every 8 steps all processes take process
+    0's flag via a broadcast collective, so every host breaks at the
+    SAME step boundary. (Slurm delivers the signal to all tasks; process
+    0's observation is the decision bit.)
+    """
+    if stop_check is None:
+        return False
+    if jax.process_count() == 1:
+        return stop_check()
+    if step_i % 8:
+        return False
+    from jax.experimental import multihost_utils
+    flag = np.array(1 if stop_check() else 0, np.int32)
+    return bool(multihost_utils.broadcast_one_to_all(flag))
+
+
 def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
-                    loader, epoch: int, lr: float,
-                    is_master: bool) -> tuple[TrainState, dict, float]:
-    """One training epoch (reference ``train()``, ``imagenet.py:97-151``)."""
+                    loader, epoch: int, lr: float, is_master: bool,
+                    stop_check=None, start_step: int = 0,
+                    ) -> tuple[TrainState, dict, float, int]:
+    """One training epoch (reference ``train()``, ``imagenet.py:97-151``).
+
+    ``start_step``: skip the first N batches — resuming an epoch that a
+    preemption interrupted after N optimizer steps (the loader's order
+    is deterministic per (seed, epoch), so the skipped batches are
+    exactly the ones already applied).
+    Returns ``(state, metrics, seconds, interrupted_at)`` where
+    ``interrupted_at`` is -1 for a completed epoch, else the number of
+    optimizer steps applied when the stop fired.
+    """
     t0 = time.time()
     data_time = AverageMeter("data")
     metric_buf = []
     lr_arr = np.float32(lr)
+    interrupted_at = -1
+    steps_done = start_step
     t_fetch = time.time()
     for step_i, batch in enumerate(loader.epoch(epoch)):
+        if step_i < start_step:
+            t_fetch = time.time()
+            continue
+        if _stop_agreed(stop_check, step_i):
+            interrupted_at = steps_done
+            break
         data_time.update(time.time() - t_fetch)
         images, labels = shard_batch(mesh, batch.images, batch.labels)
         state, metrics = train_step(state, images, labels, lr_arr)
         metric_buf.append(metrics)
+        steps_done += 1
         if is_master and cfg.log_every and (step_i + 1) % cfg.log_every == 0:
             m = np.asarray(metrics)  # syncs a step already in flight
             print(f"  epoch {epoch + 1} step {step_i + 1}/"
@@ -75,7 +147,7 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                   flush=True)
         t_fetch = time.time()
     epoch_metrics = _finalize(metric_buf)  # the only mandatory sync point
-    return state, epoch_metrics, time.time() - t0
+    return state, epoch_metrics, time.time() - t0, interrupted_at
 
 
 def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
@@ -91,11 +163,17 @@ def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
     return _finalize(metric_buf), time.time() - t0
 
 
-def run(cfg: Config) -> dict:
-    """Full training run. Returns the final summary dict."""
+def run(cfg: Config, stop_check=None) -> dict:
+    """Full training run. Returns the final summary dict.
+
+    ``stop_check``: optional zero-arg callable polled each step; when it
+    returns True the run checkpoints and exits cleanly (defaults to a
+    ``PreemptionGuard`` on SIGTERM/SIGUSR1)."""
     # cfg.backend selects the PJRT platform: "tpu" = runtime auto-select;
     # "cpu"/"gpu" are forced, overriding any environment preset.
     senv = cluster.initialize(cfg.backend or None)
+    if stop_check is None:
+        stop_check = PreemptionGuard()
     print(cluster.rank_banner(senv), flush=True)
     is_master = jax.process_index() == 0
 
@@ -144,6 +222,14 @@ def run(cfg: Config) -> dict:
     if use_ep and (not cfg.moe_every or cfg.model_parallel < 2):
         raise ValueError("--expert-parallel requires --moe-every > 0 and "
                          "--model-parallel >= 2")
+    if cfg.zero1 and (use_sp or use_tp or use_pp or use_ep):
+        raise ValueError("--zero1 currently supports the data-parallel "
+                         "path only (parallel/zero.py)")
+    if cfg.fsdp and (use_sp or use_tp or use_pp or use_ep or cfg.zero1
+                     or cfg.grad_accum > 1):
+        raise ValueError("--fsdp is its own execution path (XLA SPMD "
+                         "partitioner); it does not combine with the "
+                         "shard_map strategies, --zero1, or --grad-accum")
 
     train_loader, val_loader = make_loaders(
         cfg, jax.process_index(), jax.process_count(), global_batch)
@@ -196,8 +282,18 @@ def run(cfg: Config) -> dict:
     # equivalence (imagenet.py:215,316).
     state = create_train_state(
         init_model, jax.random.key(cfg.seed), cfg.image_size, optimizer)
+    if cfg.zero1:
+        from imagent_tpu.parallel import zero as zero_lib
+        state = state.replace(
+            opt_state=zero_lib.init_opt_state(state.params, n_data))
     state_specs = None
-    if use_ep:
+    if cfg.fsdp:
+        from imagent_tpu.parallel.fsdp import fsdp_state_specs
+        state_specs = fsdp_state_specs(state, n_data)
+    elif cfg.zero1:
+        from imagent_tpu.parallel.zero import zero1_state_specs
+        state_specs = zero1_state_specs(state)
+    elif use_ep:
         from imagent_tpu.parallel.expert_parallel import vit_moe_param_specs
         state_specs = state_partition_specs(
             state, vit_moe_param_specs(state.params))
@@ -212,27 +308,43 @@ def run(cfg: Config) -> dict:
         state_specs = state_partition_specs(
             state, vit_tp_param_specs(state.params))
     state = place_state(state, mesh, state_specs)
-    train_step = make_train_step(model, optimizer, mesh, seq_parallel=use_sp,
-                                 state_specs=state_specs,
-                                 grad_accum=cfg.grad_accum,
-                                 pipe_axis=cluster.PIPE_AXIS if use_pp
-                                 else None,
-                                 expert_parallel=use_ep,
-                                 aux_loss_weight=cfg.moe_aux_weight)
-    eval_step = make_eval_step(model, mesh, state_specs)
+    if cfg.fsdp:
+        from imagent_tpu.train import (
+            make_eval_step_auto, make_train_step_auto,
+        )
+        train_step = make_train_step_auto(
+            model, optimizer, mesh, state_specs,
+            aux_loss_weight=cfg.moe_aux_weight)
+        eval_step = make_eval_step_auto(model, mesh, state_specs)
+    else:
+        train_step = make_train_step(
+            model, optimizer, mesh, seq_parallel=use_sp,
+            state_specs=state_specs, grad_accum=cfg.grad_accum,
+            pipe_axis=cluster.PIPE_AXIS if use_pp else None,
+            expert_parallel=use_ep, aux_loss_weight=cfg.moe_aux_weight,
+            zero1=cfg.zero1, momentum=cfg.momentum,
+            weight_decay=cfg.weight_decay)
+        eval_step = make_eval_step(model, mesh, state_specs)
 
     start_epoch, best_top1, best_top5, best_epoch = 0, 0.0, 0.0, -1
+    resume_step = 0
     if cfg.resume:
         restored = ckpt_lib.restore(cfg.ckpt_dir, ckpt_lib.LAST, state)
         if restored is not None:
             state, meta = restored
             state = place_state(state, mesh, state_specs)
             start_epoch = int(meta.get("epoch", -1)) + 1
+            # Preemption checkpoints record how many optimizer steps of
+            # the interrupted epoch are already applied; resume skips
+            # exactly those batches (deterministic loader order).
+            resume_step = int(meta.get("resume_step", 0))
             best_top1 = float(meta.get("best_top1", 0.0))
             best_top5 = float(meta.get("best_top5", 0.0))
             best_epoch = int(meta.get("best_epoch", -1))
             if is_master:
-                print(f"resumed from epoch {start_epoch}", flush=True)
+                print(f"resumed from epoch {start_epoch}"
+                      + (f" step {resume_step}" if resume_step else ""),
+                      flush=True)
 
     logger = TrainLogger(cfg.log_dir, is_master)
     if cfg.check_nans:
@@ -243,10 +355,27 @@ def run(cfg: Config) -> dict:
     run_t0 = time.time()
     train_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
     val_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
+    preempted = False
     for epoch in range(start_epoch, cfg.epochs):
         lr = lr_for_epoch(cfg, epoch)
-        state, train_m, train_t = train_one_epoch(
-            cfg, mesh, train_step, state, train_loader, epoch, lr, is_master)
+        state, train_m, train_t, interrupted_at = train_one_epoch(
+            cfg, mesh, train_step, state, train_loader, epoch, lr,
+            is_master, stop_check, resume_step)
+        resume_step = 0  # only the first resumed epoch skips batches
+        if interrupted_at >= 0:
+            # Preemption: persist the mid-epoch state, recording how many
+            # of this epoch's steps it contains — --resume skips exactly
+            # those batches, so no gradient is applied twice.
+            ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.LAST, state, {
+                "epoch": epoch - 1, "resume_step": interrupted_at,
+                "best_top1": best_top1, "best_top5": best_top5,
+                "best_epoch": best_epoch})
+            if is_master:
+                print(f"preemption signal: checkpointed epoch {epoch + 1} "
+                      f"at step {interrupted_at}; exiting cleanly "
+                      "(--resume continues from there)", flush=True)
+            preempted = True
+            break
         did_eval = (epoch + 1) % cfg.eval_every == 0 or epoch == cfg.epochs - 1
         if did_eval:
             val_m, val_t = evaluate(cfg, mesh, eval_step, state,
@@ -275,4 +404,5 @@ def run(cfg: Config) -> dict:
     logger.close()
     return {"best_top1": best_top1, "best_top5": best_top5,
             "best_epoch": best_epoch, "total_minutes": total_min,
-            "final_train": train_m, "final_val": val_m}
+            "final_train": train_m, "final_val": val_m,
+            "preempted": preempted}

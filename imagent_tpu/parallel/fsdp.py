@@ -1,0 +1,83 @@
+"""FSDP (ZeRO-3-style fully sharded params) via the XLA SPMD partitioner.
+
+No reference analogue (SURVEY §2c: the reference holds a full replica
+per GPU). Unlike ``parallel/zero.py`` (ZeRO-1, hand-rolled inside
+``shard_map``), FSDP on TPU is best expressed the compiler-driven way:
+
+* every param/optimizer leaf gets a ``PartitionSpec`` sharding ONE of
+  its dims over the ``data`` axis (``fsdp_param_specs``);
+* the train step is a PLAIN function under ``jax.jit`` with
+  ``in_shardings``/``out_shardings`` — no ``shard_map``, no axis names;
+* XLA's SPMD partitioner then inserts the per-layer ``all-gather`` for
+  forward/backward use of each weight and the ``reduce-scatter`` for its
+  gradient, and schedules them to overlap with compute — exactly the
+  hand-written FSDP choreography, derived by the compiler. This is the
+  "annotate shardings, let XLA insert collectives" recipe the rest of
+  the framework uses explicit ``shard_map`` for; having both paths is
+  deliberate (explicit = full control for pp/ep/ring; auto = FSDP).
+
+Memory: params + momentum live at 1/dp per chip between steps; peak
+during the step is one layer's gathered weights at a time (XLA frees
+gathers after last use).
+
+Sharding rule: shard the largest dim divisible by the axis size; leaves
+with no divisible dim (tiny biases, scalars) stay replicated — their
+memory is negligible.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from imagent_tpu.cluster import DATA_AXIS
+
+
+def fsdp_leaf_spec(shape, n_data: int, axis: str = DATA_AXIS) -> P:
+    """Spec for one leaf: biggest dim divisible by ``n_data`` shards."""
+    if not shape:
+        return P()
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % n_data == 0 and shape[i] >= n_data:
+            spec = [None] * len(shape)
+            spec[i] = axis
+            return P(*spec)
+    return P()
+
+
+def fsdp_param_specs(params, n_data: int, axis: str = DATA_AXIS):
+    """PartitionSpec tree sharding every eligible leaf over ``axis``."""
+    return jax.tree.map(
+        lambda x: fsdp_leaf_spec(jnp.shape(x), n_data, axis), params)
+
+
+def fsdp_state_specs(state, n_data: int):
+    """TrainState-shaped spec tree: params and the params-shaped SGD
+    momentum slots shard; step/batch_stats replicate (BN stats are tiny
+    and updated with a mean — replication is the correct layout).
+    Spec-inheritance for the optimizer slots is the shared
+    ``train.state_partition_specs`` logic."""
+    from imagent_tpu.train import state_partition_specs
+    return state_partition_specs(
+        state, fsdp_param_specs(state.params, n_data))
+
+
+def shardings_from_specs(mesh: Mesh, specs):
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def sharded_fraction(state) -> float:
+    """Diagnostic: fraction of param elements whose leaves are sharded
+    (from the live array shardings)."""
+    total = sharded = 0
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        total += n
+        spec = getattr(leaf.sharding, "spec", None)
+        if spec is not None and any(s is not None for s in spec):
+            sharded += n
+    return sharded / max(total, 1)

@@ -111,6 +111,44 @@ def state_partition_specs(state: TrainState, params_specs) -> TrainState:
     )
 
 
+def make_loss_fn(model, label_smoothing: float = 0.0,
+                 aux_loss_weight: float = 0.01) -> Callable:
+    """The shared training objective: softmax CE (+ any sown aux losses,
+    e.g. the MoE load-balancing term) — used by BOTH the explicit
+    shard_map step and the FSDP auto step so the semantics can't drift.
+    Returns ``loss, (logits, per_sample, new_batch_stats)``."""
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images, train=True, mutable=["batch_stats", "intermediates"])
+        per_sample = softmax_cross_entropy(logits, labels, label_smoothing)
+        loss = per_sample.mean()
+        aux = jax.tree_util.tree_leaves(mutated.get("intermediates", {}))
+        if aux:  # static: sown aux losses (MoE load balancing)
+            loss = loss + aux_loss_weight * (sum(aux) / len(aux))
+        return loss, (logits, per_sample,
+                      mutated.get("batch_stats", {}))
+
+    return loss_fn
+
+
+def masked_eval_metrics(logits, labels, mask) -> jnp.ndarray:
+    """``[loss_sum, top1_cnt, top5_cnt, n]`` for one batch with a
+    per-sample validity mask (padded eval remainders contribute nothing
+    — SURVEY §7 "Eval sharding correctness"). Top-k membership via the
+    rank of the target logit (strictly-greater count), the shared metric
+    body of both eval paths."""
+    per_sample = softmax_cross_entropy(logits, labels) * mask
+    target_logit = jnp.take_along_axis(
+        logits.astype(jnp.float32),
+        labels[:, None].astype(jnp.int32), axis=1)
+    rank = jnp.sum(logits.astype(jnp.float32) > target_logit, axis=1)
+    c1 = jnp.sum((rank < 1) * mask)
+    c5 = jnp.sum((rank < 5) * mask)
+    return jnp.stack([per_sample.sum(), c1, c5, mask.sum()])
+
+
 def make_train_step(model, optimizer: optax.GradientTransformation,
                     mesh: Mesh, label_smoothing: float = 0.0,
                     seq_parallel: bool = False,
@@ -118,7 +156,9 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
                     grad_accum: int = 1,
                     pipe_axis: str | None = None,
                     expert_parallel: bool = False,
-                    aux_loss_weight: float = 0.01) -> Callable:
+                    aux_loss_weight: float = 0.01,
+                    zero1: bool = False, momentum: float = 0.9,
+                    weight_decay: float = 1e-4) -> Callable:
     """Build the jitted SPMD train step.
 
     ``shard_map`` over the ``data`` axis gives each device its batch shard
@@ -151,6 +191,12 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     collection (the MoE router's load-balancing term) contribute
     ``aux_loss_weight x`` their mean to the objective; reported metrics
     remain pure cross-entropy.
+
+    ``zero1``: optimizer state sharded over the data axis
+    (``parallel/zero.py``); the ``optimizer`` argument is ignored and a
+    torch-order SGD(momentum, weight_decay) runs on each shard's slice —
+    numerically identical to the replicated path. ``state.opt_state``
+    must be the flat buffer from ``zero.init_opt_state``.
     """
     if (pipe_axis is not None or expert_parallel) and state_specs is None:
         raise ValueError("pipe_axis / expert_parallel require state_specs "
@@ -160,18 +206,7 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     region_axes = ([pipe_axis] if pipe_axis is not None else []) + \
         ([MODEL_AXIS] if expert_parallel else [])
 
-    def loss_fn(params, batch_stats, images, labels):
-        logits, mutated = model.apply(
-            {"params": params, "batch_stats": batch_stats},
-            images, train=True, mutable=["batch_stats", "intermediates"])
-        per_sample = softmax_cross_entropy(logits, labels, label_smoothing)
-        loss = per_sample.mean()
-        aux = jax.tree_util.tree_leaves(mutated.get("intermediates", {}))
-        if aux:  # static: sown aux losses (MoE load balancing)
-            loss = loss + aux_loss_weight * (sum(aux) / len(aux))
-        return loss, (logits, per_sample,
-                      mutated.get("batch_stats", {}))
-
+    loss_fn = make_loss_fn(model, label_smoothing, aux_loss_weight)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def accumulate(params, batch_stats, images, labels):
@@ -226,10 +261,16 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
             from imagent_tpu.parallel.pipeline import normalize_region_grads
             grads = normalize_region_grads(grads, state_specs.params, axis)
 
-        updates, new_opt_state = optimizer.update(
-            grads, state.opt_state, state.params)
-        updates = jax.tree.map(lambda u: -lr * u, updates)
-        new_params = optax.apply_updates(state.params, updates)
+        if zero1:
+            from imagent_tpu.parallel.zero import sgd_momentum_shard_update
+            new_params, new_opt_state = sgd_momentum_shard_update(
+                state.params, grads, state.opt_state, lr,
+                momentum, weight_decay)
+        else:
+            updates, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params)
+            updates = jax.tree.map(lambda u: -lr * u, updates)
+            new_params = optax.apply_updates(state.params, updates)
 
         metrics = lax.psum(local, DATA_AXIS)
 
@@ -247,6 +288,72 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     return jax.jit(sharded, donate_argnums=(0,))
 
 
+def make_train_step_auto(model, optimizer: optax.GradientTransformation,
+                         mesh: Mesh, state_specs: TrainState,
+                         label_smoothing: float = 0.0,
+                         aux_loss_weight: float = 0.01) -> Callable:
+    """FSDP train step via the XLA SPMD partitioner (``parallel/fsdp.py``).
+
+    A PLAIN jitted function — no ``shard_map``, no axis names. Param and
+    momentum shardings come from ``state_specs`` (each leaf split over
+    the data axis); the batch is sharded over ``data``; XLA inserts the
+    per-layer all-gathers, the gradient reduce-scatters, and the metric
+    reductions, overlapping them with compute.
+
+    Numerics note vs the explicit path: loss/grads are means over the
+    GLOBAL batch (identical to DDP's mean-of-means at equal shard
+    sizes), and BatchNorm statistics are computed over the global batch
+    (SyncBN semantics) rather than per-replica — the one deliberate
+    difference, since the partitioner sees a single logical batch.
+    """
+    from imagent_tpu.parallel.fsdp import shardings_from_specs
+
+    loss_fn = make_loss_fn(model, label_smoothing, aux_loss_weight)
+
+    def step(state: TrainState, images, labels, lr):
+        (_, (logits, per_sample, new_bs)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, state.batch_stats,
+                                   images, labels)
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(
+            state.params, jax.tree.map(lambda u: -lr * u, updates))
+        c1, c5 = topk_correct(logits, labels)
+        metrics = jnp.stack([per_sample.sum(), c1, c5,
+                             jnp.float32(labels.shape[0])])
+        return state.replace(step=state.step + 1, params=new_params,
+                             batch_stats=new_bs,
+                             opt_state=new_opt_state), metrics
+
+    state_sh = shardings_from_specs(mesh, state_specs)
+    batch_sh = NamedSharding(mesh, P(DATA_AXIS))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(step,
+                   in_shardings=(state_sh, batch_sh, batch_sh, repl),
+                   out_shardings=(state_sh, repl),
+                   donate_argnums=(0,))
+
+
+def make_eval_step_auto(model, mesh: Mesh,
+                        state_specs: TrainState) -> Callable:
+    """FSDP eval step (plain jit + shardings; masked, exact on any chip
+    count like ``make_eval_step``)."""
+    from imagent_tpu.parallel.fsdp import shardings_from_specs
+
+    def eval_step(state: TrainState, images, labels, mask):
+        logits = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images, train=False)
+        return masked_eval_metrics(logits, labels, mask)
+
+    state_sh = shardings_from_specs(mesh, state_specs)
+    batch_sh = NamedSharding(mesh, P(DATA_AXIS))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(eval_step,
+                   in_shardings=(state_sh, batch_sh, batch_sh, batch_sh),
+                   out_shardings=repl)
+
+
 def make_eval_step(model, mesh: Mesh,
                    state_specs: TrainState | None = None) -> Callable:
     """Jitted eval step (reference ``validate()``, ``imagenet.py:166-210``).
@@ -261,17 +368,8 @@ def make_eval_step(model, mesh: Mesh,
         logits = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             images, train=False)
-        per_sample = softmax_cross_entropy(logits, labels) * mask
-        # Masked-out samples: force their target logit comparison to miss
-        # by weighting the correct-counts with the mask.
-        target_logit = jnp.take_along_axis(
-            logits.astype(jnp.float32),
-            labels[:, None].astype(jnp.int32), axis=1)
-        rank = jnp.sum(logits.astype(jnp.float32) > target_logit, axis=1)
-        c1 = jnp.sum((rank < 1) * mask)
-        c5 = jnp.sum((rank < 5) * mask)
-        local = jnp.stack([per_sample.sum(), c1, c5, mask.sum()])
-        return lax.psum(local, DATA_AXIS)
+        return lax.psum(masked_eval_metrics(logits, labels, mask),
+                        DATA_AXIS)
 
     st = state_specs if state_specs is not None else P()
     sharded = jax.shard_map(

@@ -42,3 +42,29 @@ def test_e2e_learns_synthetic(tmp_path):
     cfg = _tiny_cfg(tmp_path, epochs=4, lr=0.1)
     result = run(cfg)
     assert result["final_train"]["top1"] > 40.0  # chance = 25%
+
+
+def test_e2e_preemption_checkpoint_and_resume(tmp_path):
+    """Preemption aux subsystem: a stop signal mid-epoch checkpoints LAST
+    and exits cleanly; --resume redoes the interrupted epoch and
+    finishes the run."""
+    calls = {"n": 0}
+
+    def stop_after_two_steps():
+        calls["n"] += 1
+        return calls["n"] > 2
+
+    cfg = _tiny_cfg(tmp_path, epochs=2, save_model=True)
+    result = run(cfg, stop_check=stop_after_two_steps)
+    assert result["preempted"] is True
+    assert (tmp_path / "ckpt" / "last").is_dir()
+    # Mid-epoch checkpoint records the applied-step count so resume
+    # skips exactly those batches (no gradient applied twice).
+    import json
+    meta = json.loads((tmp_path / "ckpt" / "last_meta.json").read_text())
+    assert meta["epoch"] == -1 and meta["resume_step"] == 2
+
+    cfg2 = _tiny_cfg(tmp_path, epochs=2, save_model=True, resume=True)
+    result2 = run(cfg2)
+    assert result2["preempted"] is False
+    assert result2["best_epoch"] >= 0

@@ -1,0 +1,39 @@
+"""LR schedule vs the reference's observable behavior: step decay
+``lr0 * 0.1**(epoch//30)`` produced 0.1 / 0.01 / 0.001 / 1e-4 at epochs
+1 / 31 / 61 / 91 in the run of record (``imagent_sgd.out:274,454,634,814``;
+``adjust_learning_rate``, ``imagenet.py:154-162``)."""
+
+import math
+
+from imagent_tpu.config import Config
+from imagent_tpu.schedule import cosine, lr_for_epoch, step_decay
+
+
+def test_step_decay_matches_run_of_record():
+    # 0-indexed epochs; the log prints 1-indexed.
+    for epoch_1idx, want in [(1, 0.1), (30, 0.1), (31, 0.01), (60, 0.01),
+                             (61, 0.001), (90, 0.001), (91, 1e-4),
+                             (100, 1e-4)]:
+        got = step_decay(0.1, epoch_1idx - 1)
+        assert math.isclose(got, want, rel_tol=1e-9), (epoch_1idx, got)
+
+
+def test_lr_for_epoch_step_default():
+    cfg = Config(lr=0.1, epochs=100)
+    assert math.isclose(lr_for_epoch(cfg, 0), 0.1)
+    assert math.isclose(lr_for_epoch(cfg, 30), 0.01)
+    assert math.isclose(lr_for_epoch(cfg, 99), 1e-4, rel_tol=1e-9)
+
+
+def test_warmup_then_schedule():
+    cfg = Config(lr=0.1, epochs=10, warmup_epochs=5)
+    ws = [lr_for_epoch(cfg, e) for e in range(5)]
+    assert ws == [0.1 * (i + 1) / 5 for i in range(5)]  # linear ramp
+    assert math.isclose(lr_for_epoch(cfg, 5), 0.1)  # post-warmup epoch 0
+
+
+def test_cosine_endpoints():
+    cfg = Config(lr=0.1, epochs=100, schedule="cosine")
+    assert math.isclose(lr_for_epoch(cfg, 0), 0.1)
+    assert lr_for_epoch(cfg, 99) < 0.1 * 0.01  # nearly annealed out
+    assert math.isclose(cosine(0.1, 100, 100), 0.0, abs_tol=1e-12)
