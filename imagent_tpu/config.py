@@ -58,6 +58,7 @@ class Config:
     # --bf16 is on. Default off = reference parity (fp32 inputs).
     input_bf16: bool = False
     warmup_epochs: int = 0  # linear LR warmup (0 = reference behavior)
+    label_smoothing: float = 0.0  # CE smoothing (0 = reference behavior)
     # Micro-batches accumulated per optimizer step inside the compiled
     # train step: effective global batch = batch_size * data_parallel * K.
     grad_accum: int = 1
@@ -160,6 +161,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--input-bf16", action="store_true", default=False,
                    help="input pipeline emits bf16 batches (halves H2D)")
     p.add_argument("--warmup-epochs", type=int, default=c.warmup_epochs)
+    p.add_argument("--label-smoothing", type=float,
+                   default=c.label_smoothing)
     p.add_argument("--grad-accum", type=int, default=c.grad_accum,
                    help="micro-batches per optimizer step (default 1)")
     p.add_argument("--schedule", type=str, default=c.schedule,

@@ -1,0 +1,56 @@
+"""Device prefetch: overlap host→device transfer with the running step.
+
+The reference overlaps H2D with compute via pinned-memory
+``DataLoader`` + ``.cuda(non_blocking=True)`` (``imagenet.py:119-120,
+350-359``). The TPU-native equivalent: a background thread assembles the
+NEXT batch's global device arrays (``shard_batch`` →
+``make_array_from_process_local_data``) while the devices execute the
+current step — so the step dispatch never waits on the transfer.
+
+Depth 2 (double buffering) suffices: deeper queues only add device
+memory pressure (each in-flight batch holds its HBM buffers alive).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+from imagent_tpu.train import shard_batch
+
+
+def device_prefetch(mesh, batch_iter, with_mask: bool = False,
+                    depth: int = 2) -> Iterator[tuple]:
+    """Yield tuples of global device arrays, staged ``depth`` ahead.
+
+    ``batch_iter`` yields ``data.pipeline.Batch``; yields
+    ``(images, labels)`` for the train step, or with ``with_mask``
+    ``(images, labels, mask)`` for the eval step.
+    """
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    _END = object()
+
+    def producer():
+        try:
+            for batch in batch_iter:
+                if with_mask:
+                    q.put(shard_batch(mesh, batch.images, batch.labels,
+                                      batch.mask))
+                else:
+                    q.put(shard_batch(mesh, batch.images, batch.labels))
+            q.put(_END)
+        except BaseException as e:  # propagate, don't truncate the epoch
+            q.put(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            break
+        if isinstance(item, BaseException):
+            t.join()
+            raise item
+        yield item
+    t.join()

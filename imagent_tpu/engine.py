@@ -21,6 +21,7 @@ the reference's ``torch.cuda.synchronize()`` every step
 
 from __future__ import annotations
 
+import itertools
 import signal
 import time
 
@@ -31,11 +32,12 @@ from imagent_tpu import checkpoint as ckpt_lib
 from imagent_tpu import cluster
 from imagent_tpu.config import Config
 from imagent_tpu.data import make_loaders
+from imagent_tpu.data.prefetch import device_prefetch
 from imagent_tpu.models import create_model
 from imagent_tpu.schedule import lr_for_epoch
 from imagent_tpu.train import (
     TrainState, create_train_state, make_eval_step, make_optimizer,
-    make_train_step, place_state, replicate_state, shard_batch,
+    make_train_step, place_state, replicate_state,
     state_partition_specs,
 )
 from imagent_tpu.utils.logging import TrainLogger
@@ -126,16 +128,19 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
     lr_arr = np.float32(lr)
     interrupted_at = -1
     steps_done = start_step
+    it = loader.epoch(epoch)
+    if start_step:
+        it = itertools.islice(it, start_step, None)
     t_fetch = time.time()
-    for step_i, batch in enumerate(loader.epoch(epoch)):
-        if step_i < start_step:
-            t_fetch = time.time()
-            continue
+    # Batches arrive as device arrays staged one step ahead (H2D
+    # overlapped with the running step, data/prefetch.py).
+    for i, arrays in enumerate(device_prefetch(mesh, it)):
+        step_i = start_step + i
         if _stop_agreed(stop_check, step_i):
             interrupted_at = steps_done
             break
         data_time.update(time.time() - t_fetch)
-        images, labels = shard_batch(mesh, batch.images, batch.labels)
+        images, labels = arrays
         state, metrics = train_step(state, images, labels, lr_arr)
         metric_buf.append(metrics)
         steps_done += 1
@@ -156,9 +161,8 @@ def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
     exact under padding via the mask."""
     t0 = time.time()
     metric_buf = []
-    for batch in loader.epoch(epoch):
-        images, labels, mask = shard_batch(
-            mesh, batch.images, batch.labels, batch.mask)
+    for images, labels, mask in device_prefetch(
+            mesh, loader.epoch(epoch), with_mask=True):
         metric_buf.append(eval_step(state, images, labels, mask))
     return _finalize(metric_buf), time.time() - t0
 
@@ -314,11 +318,13 @@ def run(cfg: Config, stop_check=None) -> dict:
         )
         train_step = make_train_step_auto(
             model, optimizer, mesh, state_specs,
+            label_smoothing=cfg.label_smoothing,
             aux_loss_weight=cfg.moe_aux_weight)
         eval_step = make_eval_step_auto(model, mesh, state_specs)
     else:
         train_step = make_train_step(
             model, optimizer, mesh, seq_parallel=use_sp,
+            label_smoothing=cfg.label_smoothing,
             state_specs=state_specs, grad_accum=cfg.grad_accum,
             pipe_axis=cluster.PIPE_AXIS if use_pp else None,
             expert_parallel=use_ep, aux_loss_weight=cfg.moe_aux_weight,

@@ -138,3 +138,46 @@ def test_input_bf16_batches():
     assert batch.images.dtype == ml_dtypes.bfloat16
     assert batch.labels.dtype == np.int32
     assert batch.mask.dtype == np.float32
+
+
+def test_device_prefetch_matches_direct_sharding():
+    """The prefetcher yields the same device arrays, in order, as direct
+    shard_batch calls, for both train (2-tuple) and eval (3-tuple)."""
+    import jax
+
+    from imagent_tpu.cluster import make_mesh
+    from imagent_tpu.config import Config
+    from imagent_tpu.data.prefetch import device_prefetch
+    from imagent_tpu.data.synthetic import SyntheticLoader
+    from imagent_tpu.train import shard_batch
+
+    cfg = Config(dataset="synthetic", synthetic_size=32, image_size=8,
+                 num_classes=4, batch_size=2)
+    loader = SyntheticLoader(cfg, 0, 1, global_batch=8, train=True)
+    mesh = make_mesh(model_parallel=1)
+
+    direct = [shard_batch(mesh, b.images, b.labels)
+              for b in loader.epoch(0)]
+    staged = list(device_prefetch(mesh, loader.epoch(0)))
+    assert len(direct) == len(staged) == loader.steps_per_epoch
+    for (di, dl), (si, sl) in zip(direct, staged):
+        np.testing.assert_array_equal(np.asarray(di), np.asarray(si))
+        np.testing.assert_array_equal(np.asarray(dl), np.asarray(sl))
+
+    val = SyntheticLoader(cfg, 0, 1, global_batch=8, train=False)
+    three = next(iter(device_prefetch(mesh, val.epoch(0), with_mask=True)))
+    assert len(three) == 3
+
+
+def test_device_prefetch_propagates_errors():
+    from imagent_tpu.cluster import make_mesh
+    from imagent_tpu.data.prefetch import device_prefetch
+
+    def gen():
+        raise RuntimeError("decode failed")
+        yield  # pragma: no cover
+
+    mesh = make_mesh(model_parallel=1)
+    import pytest
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(device_prefetch(mesh, gen()))
