@@ -241,45 +241,45 @@ def run(cfg: Config, stop_check=None) -> dict:
     if use_sp:
         model = create_model(
             cfg.arch, cfg.num_classes, cfg.bf16, gap_readout=True,
-            attn_impl=cfg.seq_parallel, seq_axis=cluster.MODEL_AXIS)
+            attn_impl=cfg.seq_parallel, seq_axis=cluster.MODEL_AXIS, remat=cfg.remat)
         # Same param tree, no mesh-axis ops — usable for host-side init.
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                                  gap_readout=True)
+                                  gap_readout=True, remat=cfg.remat)
     elif cfg.moe_every:
         moe_kw = dict(moe_every=cfg.moe_every, num_experts=cfg.num_experts,
                       capacity_factor=cfg.capacity_factor,
                       moe_groups=cfg.moe_groups)
         model = create_model(
             cfg.arch, cfg.num_classes, cfg.bf16, attn_impl=cfg.attn,
-            expert_axis=cluster.MODEL_AXIS if use_ep else None, **moe_kw)
+            expert_axis=cluster.MODEL_AXIS if use_ep else None, **moe_kw, remat=cfg.remat)
         # Host-side init twin: same param tree; EP consumes slices of it.
         # groups=1 — params don't depend on the capacity grouping, and
         # the init batch (2 images) need not divide the run's groups.
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                                   attn_impl=cfg.attn,
-                                  **{**moe_kw, "moe_groups": 1})
+                                  **{**moe_kw, "moe_groups": 1}, remat=cfg.remat)
     elif use_pp:
         model = create_model(
             cfg.arch, cfg.num_classes, cfg.bf16, attn_impl=cfg.attn,
             pipe_axis=cluster.PIPE_AXIS, microbatches=cfg.microbatches,
-            tp_axis=cluster.MODEL_AXIS if use_tp else None)
+            tp_axis=cluster.MODEL_AXIS if use_tp else None, remat=cfg.remat)
         # Host-side init uses the layer-stacked pipe-free twin (same
         # param tree, parallel/pipeline.py).
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                                  attn_impl=cfg.attn, stacked=True)
+                                  attn_impl=cfg.attn, stacked=True, remat=cfg.remat)
     elif use_tp:
         model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                             attn_impl=cfg.attn, tp_axis=cluster.MODEL_AXIS)
+                             attn_impl=cfg.attn, tp_axis=cluster.MODEL_AXIS, remat=cfg.remat)
         # Host-side init uses the unsharded twin; TP consumes slices of
         # the same param tree (parallel/tensor_parallel.py).
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                                  attn_impl=cfg.attn)
+                                  attn_impl=cfg.attn, remat=cfg.remat)
     elif cfg.arch.startswith("vit") and cfg.attn != "full":
         model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                             attn_impl=cfg.attn)
+                             attn_impl=cfg.attn, remat=cfg.remat)
         init_model = model
     else:
-        model = create_model(cfg.arch, cfg.num_classes, cfg.bf16)
+        model = create_model(cfg.arch, cfg.num_classes, cfg.bf16, remat=cfg.remat)
         init_model = model
     optimizer = make_optimizer(cfg.momentum, cfg.weight_decay)
     # Same seed on every process ⇒ identical init, the DDP broadcast

@@ -43,8 +43,9 @@ def create_model(arch: str, num_classes: int = 1000, bf16: bool = False,
         from imagent_tpu.models import vit
         return vit.create_vit(arch, num_classes=num_classes, dtype=dtype,
                               **overrides)
+    remat = overrides.pop("remat", False)  # shared flag, both families
     if overrides:
         raise ValueError(f"overrides {sorted(overrides)} only apply to ViT")
     if arch not in _REGISTRY:
         raise ValueError(f"unknown arch {arch!r}; one of {available_models()}")
-    return _REGISTRY[arch](num_classes=num_classes, dtype=dtype)
+    return _REGISTRY[arch](num_classes=num_classes, dtype=dtype, remat=remat)

@@ -177,6 +177,7 @@ class VisionTransformer(nn.Module):
     capacity_factor: float = 1.25
     moe_groups: int = 1           # capacity groups in the unsharded twin
     expert_axis: str | None = None  # mesh axis for expert parallelism
+    remat: bool = False  # jax.checkpoint each block (recompute on bwd)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -211,13 +212,14 @@ class VisionTransformer(nn.Module):
             idx = lax.axis_index(self.seq_axis)
             x = lax.dynamic_slice_in_dim(x, idx * n_local, n_local, axis=1)
 
+        block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         if self.stacked or self.pipe_axis is not None:
             if self.moe_every:
                 raise ValueError(
                     "MoE is not supported on the stacked/pipelined encoder "
                     "(heterogeneous layers break the nn.scan stack)")
             from imagent_tpu.parallel.pipeline import Pipeline
-            body = partial(EncoderBlock, self.num_heads, self.mlp_dim,
+            body = partial(block_cls, self.num_heads, self.mlp_dim,
                            dtype=self.dtype, attn_impl=self.attn_impl,
                            seq_axis=self.seq_axis, tp_axis=self.tp_axis,
                            name="block")
@@ -228,14 +230,14 @@ class VisionTransformer(nn.Module):
             for i in range(self.num_layers):
                 moe = (self.moe_every > 0
                        and i % self.moe_every == self.moe_every - 1)
-                x = EncoderBlock(self.num_heads, self.mlp_dim,
-                                 dtype=self.dtype, attn_impl=self.attn_impl,
-                                 seq_axis=self.seq_axis, tp_axis=self.tp_axis,
-                                 moe=moe, num_experts=self.num_experts,
-                                 capacity_factor=self.capacity_factor,
-                                 moe_groups=self.moe_groups,
-                                 expert_axis=self.expert_axis,
-                                 name=f"encoder_layer_{i}")(x)
+                x = block_cls(self.num_heads, self.mlp_dim,
+                              dtype=self.dtype, attn_impl=self.attn_impl,
+                              seq_axis=self.seq_axis, tp_axis=self.tp_axis,
+                              moe=moe, num_experts=self.num_experts,
+                              capacity_factor=self.capacity_factor,
+                              moe_groups=self.moe_groups,
+                              expert_axis=self.expert_axis,
+                              name=f"encoder_layer_{i}")(x)
         x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="ln")(x)
         if use_cls:
             pooled = x[:, 0]
