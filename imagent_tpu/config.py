@@ -36,6 +36,10 @@ class Config:
     data_root: str = "../data/imagenet"  # imagenet.py:287-289
     momentum: float = 0.9  # imagenet.py:325
     weight_decay: float = 1e-4  # imagenet.py:325
+    # sgd (reference parity) | nadam (the optimizer the reference's dead
+    # `custom_optimizers` import pointed at, imagenet.py:36) | adamw |
+    # lars (large-batch SGD).
+    optimizer: str = "sgd"
     lr_decay_period: int = 30  # imagenet.py:158
     lr_decay_factor: float = 0.1  # imagenet.py:158
     workers: int = 10  # imagenet.py:352
@@ -143,6 +147,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-root", type=str, default=c.data_root)
     p.add_argument("--momentum", type=float, default=c.momentum)
     p.add_argument("--weight-decay", type=float, default=c.weight_decay)
+    p.add_argument("--optimizer", type=str, default=c.optimizer,
+                   choices=["sgd", "nadam", "adamw", "lars"])
     p.add_argument("--lr-decay-period", type=int, default=c.lr_decay_period)
     p.add_argument("--lr-decay-factor", type=float, default=c.lr_decay_factor)
     p.add_argument("--workers", type=int, default=c.workers)

@@ -281,7 +281,11 @@ def run(cfg: Config, stop_check=None) -> dict:
     else:
         model = create_model(cfg.arch, cfg.num_classes, cfg.bf16, remat=cfg.remat)
         init_model = model
-    optimizer = make_optimizer(cfg.momentum, cfg.weight_decay)
+    if cfg.zero1 and cfg.optimizer != "sgd":
+        raise ValueError("--zero1 implements the sharded SGD update; use "
+                         "--fsdp for other optimizers")
+    optimizer = make_optimizer(cfg.momentum, cfg.weight_decay,
+                               cfg.optimizer)
     # Same seed on every process ⇒ identical init, the DDP broadcast
     # equivalence (imagenet.py:215,316).
     state = create_train_state(

@@ -60,17 +60,50 @@ class TrainState(flax.struct.PyTreeNode):
 
 
 def make_optimizer(momentum: float = 0.9,
-                   weight_decay: float = 1e-4) -> optax.GradientTransformation:
-    """torch.optim.SGD(momentum, weight_decay) with exact update order
-    (``imagenet.py:325``): grad += wd*param, then momentum trace. The LR is
-    applied by the caller each step (mirrors ``adjust_learning_rate``
-    writing ``param_groups`` per-epoch, ``imagenet.py:154-162``), so the
-    transformation itself is LR-free.
+                   weight_decay: float = 1e-4,
+                   name: str = "sgd") -> optax.GradientTransformation:
+    """LR-free optimizer by name. The LR is applied by the caller each
+    step (mirrors ``adjust_learning_rate`` writing ``param_groups``
+    per-epoch, ``imagenet.py:154-162``), so every transformation here
+    yields a *direction* the step scales by ``-lr``.
+
+    * ``sgd`` (parity): torch.optim.SGD order (``imagenet.py:325``) —
+      grad += wd*param, then momentum trace.
+    * ``nadam``: the optimizer the reference *intended* to try — its
+      ``from custom_optimizers import FR, Nadam`` (``imagenet.py:36``)
+      references a module missing from the repo; here Nesterov-Adam is a
+      real option (L2-coupled wd, like torch.optim.NAdam's default).
+    * ``adamw``: decoupled weight decay (applied after the Adam scaling,
+      so it rides the caller's lr — Loshchilov & Hutter semantics).
+    * ``lars``: layerwise trust-ratio scaling for large-batch SGD.
     """
-    return optax.chain(
-        optax.add_decayed_weights(weight_decay),
-        optax.trace(decay=momentum, nesterov=False),
-    )
+    if name == "sgd":
+        return optax.chain(
+            optax.add_decayed_weights(weight_decay),
+            optax.trace(decay=momentum, nesterov=False),
+        )
+    if name == "nadam":
+        return optax.chain(
+            optax.add_decayed_weights(weight_decay),
+            optax.scale_by_adam(nesterov=True),
+        )
+    if name == "adamw":
+        return optax.chain(
+            optax.scale_by_adam(),
+            optax.add_decayed_weights(weight_decay),
+        )
+    if name == "lars":
+        # optax.lars is lr-parameterized and already NEGATES its update
+        # (scale_by_learning_rate); flip the sign back so the caller's
+        # uniform -lr factor applies — learning_rate=1.0 makes the
+        # trust-ratio scaling compose multiplicatively with it.
+        return optax.chain(
+            optax.lars(learning_rate=1.0, weight_decay=weight_decay,
+                       momentum=momentum),
+            optax.scale(-1.0),
+        )
+    raise ValueError(f"unknown optimizer {name!r}; "
+                     "one of sgd|nadam|adamw|lars")
 
 
 def create_train_state(model, rng: jax.Array, image_size: int,
@@ -91,18 +124,29 @@ def create_train_state(model, rng: jax.Array, image_size: int,
 
 def state_partition_specs(state: TrainState, params_specs) -> TrainState:
     """TrainState-shaped tree of PartitionSpecs from a params spec tree
-    (tensor parallelism, ``parallel/tensor_parallel.py``). Optimizer slots
-    inherit their parameter's spec when the state mirrors the param tree
-    (true for the SGD chain: trace slots are params-shaped); anything
-    unrecognized stays replicated."""
-    p_leaves, _ = jax.tree_util.tree_flatten(state.params)
-    s_leaves, _ = jax.tree_util.tree_flatten(params_specs)
-    o_leaves, o_tree = jax.tree_util.tree_flatten(state.opt_state)
-    if ([jnp.shape(x) for x in o_leaves]
-            == [jnp.shape(x) for x in p_leaves]):
-        opt_specs = jax.tree_util.tree_unflatten(o_tree, s_leaves)
-    else:  # unknown optimizer layout: replicate its state
-        opt_specs = jax.tree.map(lambda _: P(), state.opt_state)
+    (tensor parallelism, ``parallel/tensor_parallel.py``; FSDP,
+    ``parallel/fsdp.py``). Optimizer slots inherit their parameter's
+    spec wherever the optimizer state embeds a params-shaped subtree —
+    true for the SGD trace (one), Adam/NAdam (mu and nu), LARS —
+    detected structurally, so any optax chain whose slots mirror the
+    param tree shards correctly; scalars (Adam's count) and anything
+    unrecognized stay replicated."""
+    p_tdef = jax.tree_util.tree_structure(state.params)
+    p_shapes = [jnp.shape(x)
+                for x in jax.tree_util.tree_leaves(state.params)]
+
+    def is_param_tree(sub) -> bool:
+        try:
+            if jax.tree_util.tree_structure(sub) != p_tdef:
+                return False
+            return [jnp.shape(x)
+                    for x in jax.tree_util.tree_leaves(sub)] == p_shapes
+        except (TypeError, ValueError):
+            return False
+
+    opt_specs = jax.tree_util.tree_map(
+        lambda sub: params_specs if is_param_tree(sub) else P(),
+        state.opt_state, is_leaf=is_param_tree)
     return TrainState(
         step=P(),
         params=params_specs,
