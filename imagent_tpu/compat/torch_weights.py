@@ -1,0 +1,140 @@
+"""torchvision checkpoint import: torch state_dicts → our param trees.
+
+The reference saves ``model.state_dict()`` of a torchvision ResNet
+(``imagenet.py:392``, DDP-wrapped so keys carry a ``module.`` prefix).
+This module lets a user of the reference bring those checkpoints — or
+any torchvision ResNet/ViT weights — into this framework: the converted
+tree drops into ``TrainState.params``/``batch_stats`` and the Flax
+forward reproduces the torch forward numerically (pinned by
+``tests/test_torch_compat.py``, which runs real torch CPU models against
+the converted weights).
+
+Pure numpy: accepts any mapping of ``name -> array-like`` (a torch
+state_dict works directly; ``.numpy()`` is applied via ``np.asarray``),
+no torch import required here.
+
+Layout notes:
+* torch conv weight OIHW → Flax HWIO (transpose 2,3,1,0);
+* torch Linear weight [out,in] → Flax kernel [in,out];
+* BatchNorm weight/bias → scale/bias (params), running_mean/var →
+  mean/var (batch_stats);
+* ViT fused ``in_proj_weight`` [3D,D] splits into query/key/value
+  DenseGeneral kernels [D,H,hd]; ``out_proj`` becomes the [H,hd,D]
+  DenseGeneral.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _strip_module(sd: dict) -> dict:
+    """Drop DDP's ``module.`` prefix (``imagenet.py:316,392``)."""
+    return {k[len("module."):] if k.startswith("module.") else k:
+            np.asarray(v) for k, v in sd.items()}
+
+
+def _conv(w) -> np.ndarray:
+    return np.transpose(np.asarray(w), (2, 3, 1, 0))  # OIHW -> HWIO
+
+
+def _linear(w) -> np.ndarray:
+    return np.transpose(np.asarray(w), (1, 0))  # [out,in] -> [in,out]
+
+
+def resnet_from_torch(state_dict: dict,
+                      stage_sizes) -> tuple[dict, dict]:
+    """torchvision ResNet state_dict → (params, batch_stats) trees
+    matching ``models/resnet.py`` naming. ``stage_sizes`` e.g.
+    ``(2, 2, 2, 2)`` for resnet18."""
+    sd = _strip_module(state_dict)
+    params: dict = {}
+    stats: dict = {}
+
+    def put_bn(dst_p: dict, dst_s: dict, name: str, src: str):
+        dst_p[name] = {"scale": sd[f"{src}.weight"],
+                       "bias": sd[f"{src}.bias"]}
+        dst_s[name] = {"mean": sd[f"{src}.running_mean"],
+                       "var": sd[f"{src}.running_var"]}
+
+    params["conv1"] = {"kernel": _conv(sd["conv1.weight"])}
+    put_bn(params, stats, "bn1", "bn1")
+
+    for i, n_blocks in enumerate(stage_sizes):
+        for j in range(n_blocks):
+            src = f"layer{i + 1}.{j}"
+            name = f"layer{i + 1}_block{j}"
+            p: dict = {}
+            s: dict = {}
+            k = 0
+            while f"{src}.conv{k + 1}.weight" in sd:
+                p[f"Conv_{k}"] = {
+                    "kernel": _conv(sd[f"{src}.conv{k + 1}.weight"])}
+                put_bn(p, s, f"BatchNorm_{k}", f"{src}.bn{k + 1}")
+                k += 1
+            if f"{src}.downsample.0.weight" in sd:
+                p["downsample_conv"] = {
+                    "kernel": _conv(sd[f"{src}.downsample.0.weight"])}
+                put_bn(p, s, "downsample_bn", f"{src}.downsample.1")
+            params[name] = p
+            stats[name] = s
+
+    params["fc"] = {"kernel": _linear(sd["fc.weight"]),
+                    "bias": sd["fc.bias"]}
+    return params, stats
+
+
+def vit_from_torch(state_dict: dict, num_heads: int) -> dict:
+    """torchvision ViT (vit_b_16/vit_l_16) state_dict → params tree
+    matching ``models/vit.py`` (per-layer encoder, class-token readout).
+    Returns params only (ViT has no batch_stats)."""
+    sd = _strip_module(state_dict)
+    d = sd["class_token"].shape[-1]
+    hd = d // num_heads
+    params: dict = {
+        "conv_proj": {"kernel": _conv(sd["conv_proj.weight"]),
+                      "bias": sd["conv_proj.bias"]},
+        "class_token": np.asarray(sd["class_token"]).reshape(1, 1, d),
+        "pos_embedding": np.asarray(
+            sd["encoder.pos_embedding"]).reshape(1, -1, d),
+        "ln": {"scale": sd["encoder.ln.weight"],
+               "bias": sd["encoder.ln.bias"]},
+        "head": {"kernel": _linear(sd["heads.head.weight"]),
+                 "bias": sd["heads.head.bias"]},
+    }
+
+    i = 0
+    while f"encoder.layers.encoder_layer_{i}.ln_1.weight" in sd:
+        src = f"encoder.layers.encoder_layer_{i}"
+        in_w = np.asarray(sd[f"{src}.self_attention.in_proj_weight"])
+        in_b = np.asarray(sd[f"{src}.self_attention.in_proj_bias"])
+        qw, kw, vw = np.split(in_w, 3, axis=0)      # each [D, D] (out,in)
+        qb, kb, vb = np.split(in_b, 3, axis=0)
+        out_w = np.asarray(sd[f"{src}.self_attention.out_proj.weight"])
+
+        def qkv(w, b):
+            # [D_out, D_in] -> kernel [D_in, H, hd]; bias [H, hd]
+            return {"kernel": _linear(w).reshape(d, num_heads, hd),
+                    "bias": b.reshape(num_heads, hd)}
+
+        params[f"encoder_layer_{i}"] = {
+            "ln_1": {"scale": sd[f"{src}.ln_1.weight"],
+                     "bias": sd[f"{src}.ln_1.bias"]},
+            "ln_2": {"scale": sd[f"{src}.ln_2.weight"],
+                     "bias": sd[f"{src}.ln_2.bias"]},
+            "self_attention": {
+                "query": qkv(qw, qb),
+                "key": qkv(kw, kb),
+                "value": qkv(vw, vb),
+                # [D_out, D_in] with D_in = H*hd -> [H, hd, D_out]
+                "out": {"kernel": _linear(out_w).reshape(
+                    num_heads, hd, d),
+                    "bias": sd[f"{src}.self_attention.out_proj.bias"]},
+            },
+            "mlp_0": {"kernel": _linear(sd[f"{src}.mlp.0.weight"]),
+                      "bias": sd[f"{src}.mlp.0.bias"]},
+            "mlp_1": {"kernel": _linear(sd[f"{src}.mlp.3.weight"]),
+                      "bias": sd[f"{src}.mlp.3.bias"]},
+        }
+        i += 1
+    return params

@@ -49,6 +49,10 @@ class Config:
 
     # ---- new capabilities (absent in reference) ----
     resume: bool = False  # full-state resume (reference has none, SURVEY §5)
+    # Initialize params from a torch .pt state_dict (the reference's
+    # checkpoint format, imagenet.py:392, DDP "module." prefix handled) —
+    # converted via compat/torch_weights.py. ResNet + ViT archs.
+    init_from_torch: str = ""
     # RandomResizedCrop + hflip train augmentation. The reference has NONE
     # (SURVEY §0: Resize+Normalize only, hence its 63% top-1); required for
     # the north-star accuracy config (BASELINE.md).
@@ -159,6 +163,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt-dir", type=str, default=c.ckpt_dir)
     # New capabilities.
     p.add_argument("--resume", action="store_true", default=False)
+    p.add_argument("--init-from-torch", type=str, default="",
+                   help="torch .pt state_dict to convert and load "
+                        "(the reference's checkpoint format)")
     p.add_argument("--augment", action="store_true", default=False,
                    help="RandomResizedCrop+hflip train augmentation "
                         "(reference parity is OFF)")

@@ -167,6 +167,42 @@ def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
     return _finalize(metric_buf), time.time() - t0
 
 
+def _load_torch_weights(cfg: Config, state: TrainState) -> TrainState:
+    """Convert a torch ``state_dict`` checkpoint (the reference's save
+    format, ``imagenet.py:392``) into this state's params/batch_stats.
+    Shape agreement with the freshly-initialized tree is enforced, so
+    arch/num-classes mismatches fail loudly."""
+    import torch
+
+    from imagent_tpu.compat import resnet_from_torch, vit_from_torch
+
+    sd = torch.load(cfg.init_from_torch, map_location="cpu")
+    if isinstance(sd, dict) and "state_dict" in sd:
+        sd = sd["state_dict"]
+    sd = {k: v.numpy() for k, v in sd.items()}
+    if cfg.arch.startswith("vit"):
+        from imagent_tpu.models.vit import VIT_REGISTRY
+        params = vit_from_torch(sd, VIT_REGISTRY[cfg.arch]["num_heads"])
+        stats = state.batch_stats
+    else:
+        from imagent_tpu.models.resnet import STAGE_SIZES
+        params, stats = resnet_from_torch(sd, STAGE_SIZES[cfg.arch])
+
+    def check(path, old, new):
+        new = np.asarray(new, dtype=np.asarray(old).dtype)
+        if np.shape(new) != np.shape(old):
+            raise ValueError(
+                f"torch checkpoint shape mismatch at "
+                f"{jax.tree_util.keystr(path)}: {np.shape(new)} vs "
+                f"{np.shape(old)} (wrong --arch/--num-classes?)")
+        return new
+
+    params = jax.tree_util.tree_map_with_path(check, state.params, params)
+    stats = jax.tree_util.tree_map_with_path(check, state.batch_stats,
+                                             stats)
+    return state.replace(params=params, batch_stats=stats)
+
+
 def run(cfg: Config, stop_check=None) -> dict:
     """Full training run. Returns the final summary dict.
 
@@ -290,6 +326,11 @@ def run(cfg: Config, stop_check=None) -> dict:
     # equivalence (imagenet.py:215,316).
     state = create_train_state(
         init_model, jax.random.key(cfg.seed), cfg.image_size, optimizer)
+    if cfg.init_from_torch:
+        state = _load_torch_weights(cfg, state)
+        if is_master:
+            print(f"initialized params from torch checkpoint "
+                  f"{cfg.init_from_torch}", flush=True)
     if cfg.zero1:
         from imagent_tpu.parallel import zero as zero_lib
         state = state.replace(
