@@ -148,6 +148,11 @@ def initialize(backend: str | None = None,
     selects the PJRT platform (the reference's ``--backend nccl`` analogue,
     ``imagenet.py:440``).
     """
+    # Operator-compat mapping for the reference's flag values
+    # (``imagenet.py:440``, invoked as ``--backend=nccl`` at
+    # ``imagenet.sh:26``): nccl = "the accelerator fabric" -> TPU
+    # runtime; gloo = "CPU fallback" -> cpu.
+    backend = {"nccl": "tpu", "gloo": "cpu"}.get(backend, backend)
     if backend and backend != "tpu":
         # Force the requested platform. "tpu" deliberately leaves the
         # runtime's own accelerator auto-selection in place (the TPU

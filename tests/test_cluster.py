@@ -79,3 +79,25 @@ def test_rank_banner():
            "SLURM_JOB_NODELIST": "h[1-2]"}
     banner = rank_banner(parse_slurm_env(env))
     assert "rank 1/2" in banner and "h1" in banner
+
+
+def test_backend_compat_mapping(monkeypatch):
+    """The reference's exact invocation values (--backend=nccl at
+    imagenet.sh:26, gloo as its CPU fallback) map onto PJRT platforms
+    instead of crashing."""
+    import os
+
+    import jax
+
+    from imagent_tpu.cluster import initialize
+
+    calls = {}
+    monkeypatch.setattr(jax.config, "update",
+                        lambda k, v: calls.setdefault(k, v))
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.setdefault("dist", kw))
+    initialize("gloo", env={})
+    assert calls.get("jax_platforms") == "cpu"
+    calls.clear()
+    initialize("nccl", env={})  # tpu: leaves runtime auto-selection alone
+    assert "jax_platforms" not in calls
