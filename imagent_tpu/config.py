@@ -49,6 +49,8 @@ class Config:
 
     # ---- new capabilities (absent in reference) ----
     resume: bool = False  # full-state resume (reference has none, SURVEY §5)
+    # Run validation only (on the resumed/initialized params), no training.
+    eval_only: bool = False
     # Initialize params from a torch .pt state_dict (the reference's
     # checkpoint format, imagenet.py:392, DDP "module." prefix handled) —
     # converted via compat/torch_weights.py. ResNet + ViT archs.
@@ -145,7 +147,8 @@ def build_parser() -> argparse.ArgumentParser:
     # Promoted constants.
     p.add_argument("--arch", type=str, default=c.arch,
                    choices=["resnet18", "resnet34", "resnet50",
-                            "resnet101", "resnet152", "vit_b16", "vit_l16"])
+                            "resnet101", "resnet152", "vit_b16", "vit_l16",
+                            "vit_h14"])
     p.add_argument("--image-size", type=int, default=c.image_size)
     p.add_argument("--num-classes", type=int, default=c.num_classes)
     p.add_argument("--data-root", type=str, default=c.data_root)
@@ -163,6 +166,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt-dir", type=str, default=c.ckpt_dir)
     # New capabilities.
     p.add_argument("--resume", action="store_true", default=False)
+    p.add_argument("--eval-only", action="store_true", default=False,
+                   help="validate only (with --resume or "
+                        "--init-from-torch), no training")
     p.add_argument("--init-from-torch", type=str, default="",
                    help="torch .pt state_dict to convert and load "
                         "(the reference's checkpoint format)")

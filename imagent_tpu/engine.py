@@ -407,6 +407,23 @@ def run(cfg: Config, stop_check=None) -> dict:
     train_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
     val_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
     preempted = False
+
+    if cfg.eval_only:
+        # Validation pass on the current params (--resume /
+        # --init-from-torch supply them); no training, no checkpoint.
+        val_m, val_t = evaluate(cfg, mesh, eval_step, state,
+                                val_loader, max(start_epoch - 1, 0))
+        if is_master:
+            print(f"eval-only: val loss {val_m['loss']:.4f} "
+                  f"top1 {val_m['top1']:.3f} top5 {val_m['top5']:.3f} "
+                  f"({val_m['n']} samples, {val_t:.1f}s)", flush=True)
+        logger.close()
+        return {"best_top1": val_m["top1"], "best_top5": val_m["top5"],
+                "best_epoch": start_epoch - 1,
+                "total_minutes": (time.time() - run_t0) / 60.0,
+                "final_train": train_m, "final_val": val_m,
+                "preempted": False}
+
     for epoch in range(start_epoch, cfg.epochs):
         lr = lr_for_epoch(cfg, epoch)
         state, train_m, train_t, interrupted_at = train_one_epoch(

@@ -256,9 +256,13 @@ VIT_REGISTRY = {
                     num_heads=12, mlp_dim=3072),
     "vit_l16": dict(patch_size=16, hidden_dim=1024, num_layers=24,
                     num_heads=16, mlp_dim=4096),
+    "vit_h14": dict(patch_size=14, hidden_dim=1280, num_layers=32,
+                    num_heads=16, mlp_dim=5120),
 }
 
-# torchvision reference param counts at 1000 classes.
+# torchvision reference param counts at 1000 classes (vit_h_14 at its
+# torchvision-default 518px pos-embedding uses 224px here: count below
+# is for 224px input, matching this module's init geometry).
 VIT_PARAM_COUNTS = {
     "vit_b16": 86_567_656,
     "vit_l16": 304_326_632,

@@ -68,3 +68,13 @@ def test_e2e_preemption_checkpoint_and_resume(tmp_path):
     result2 = run(cfg2)
     assert result2["preempted"] is False
     assert result2["best_epoch"] >= 0
+
+
+def test_e2e_eval_only(tmp_path):
+    """--eval-only: restores the checkpoint and validates, no training."""
+    cfg = _tiny_cfg(tmp_path, epochs=1, save_model=True)
+    run(cfg)
+    cfg2 = _tiny_cfg(tmp_path, resume=True, eval_only=True)
+    result = run(cfg2)
+    assert result["final_val"]["n"] > 0
+    assert result["final_train"]["top1"] == 0.0  # nothing trained
