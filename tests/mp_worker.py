@@ -1,0 +1,79 @@
+"""Worker for the two-process distributed test (test_multiprocess.py).
+
+Runs the REAL multi-host path end-to-end: Slurm env contract
+(``imagenet.py:225-238``) → ``cluster.initialize`` →
+``jax.distributed.initialize`` rendezvous → global mesh spanning both
+processes → per-process batch shards → one jitted train step whose
+gradient/metric psum crosses the process boundary. Prints the metric
+vector; the parent asserts both ranks agree and match a single-process
+run on the concatenated batch.
+
+Usage: python mp_worker.py <rank> <port>
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2")
+    os.environ.update({
+        "SLURM_JOB_NUM_NODES": "2",
+        "SLURM_NODEID": str(rank),
+        "SLURM_LOCALID": "0",
+        "SLURM_PROCID": str(rank),
+        "SLURM_NTASKS": "2",
+        "SLURM_JOB_NODELIST": "127.0.0.1",
+    })
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from imagent_tpu import cluster
+    from imagent_tpu.models.vit import VisionTransformer
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step,
+        replicate_state, shard_batch,
+    )
+
+    senv = cluster.initialize("cpu", port=port)
+    assert senv is not None and senv.world_size == 2
+    print(cluster.rank_banner(senv), flush=True)
+
+    mesh = cluster.make_mesh()
+    assert mesh.devices.size == 4  # 2 fake devices per process
+
+    # ViT, not ResNet: tiny-image BatchNorm normalizes over ~2 values
+    # per channel in the late stages, which amplifies ulp-level
+    # conv-algorithm differences between compilations into large loss
+    # changes — LayerNorm has no such chaos, so cross-process parity
+    # can be asserted tightly.
+    model = VisionTransformer(patch_size=8, hidden_dim=32, num_layers=2,
+                              num_heads=4, mlp_dim=64, num_classes=4)
+    opt = make_optimizer()
+    state = replicate_state(
+        create_train_state(model, jax.random.key(0), 32, opt), mesh)
+    step = make_train_step(model, opt, mesh)
+
+    # Global batch 8; this process contributes rows [rank*4, rank*4+4).
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(8,)).astype(np.int32)
+    lo = rank * 4
+    gi, gl = shard_batch(mesh, images[lo:lo + 4], labels[lo:lo + 4])
+    assert gi.shape == (8, 32, 32, 3)  # global shape spans both procs
+
+    _, metrics = step(state, gi, gl, np.float32(0.05))
+    m = np.asarray(metrics)
+    print("METRICS", " ".join(f"{x:.6f}" for x in m), flush=True)
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
