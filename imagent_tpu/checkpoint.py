@@ -7,6 +7,15 @@ resume path at all (SURVEY §5 "Checkpoint / resume"). This module closes
 that gap: the full ``{params, batch_stats, opt_state, step}`` bundle plus
 ``{epoch, best_top1, best_top5}`` metadata round-trips, enabling
 ``--resume`` after preemption (which matters far more on TPU pods).
+
+Async saves: Orbax's ``StandardCheckpointer`` stages (device→host) and
+finalizes in a background thread. ``save(..., block=False)`` returns as
+soon as staging is done — training overlaps the serialization of the
+per-epoch LAST checkpoint. Correctness rule: the JSON meta sidecar is
+written only AFTER its checkpoint data is durably finalized (Orbax's
+atomic rename), so a kill mid-save can never leave meta describing
+newer state than the directory holds — the pending meta is flushed by
+the next ``save``/``wait_until_finished`` call.
 """
 
 from __future__ import annotations
@@ -24,30 +33,72 @@ from imagent_tpu.train import TrainState
 BEST = "best"
 LAST = "last"
 
+_ckptr: ocp.StandardCheckpointer | None = None
+_pending_meta: tuple[str, str, dict] | None = None
+
+
+def _checkpointer() -> ocp.StandardCheckpointer:
+    global _ckptr
+    if _ckptr is None:
+        _ckptr = ocp.StandardCheckpointer()
+    return _ckptr
+
 
 def _meta_path(ckpt_dir: str, name: str) -> str:
     return os.path.join(ckpt_dir, f"{name}_meta.json")
 
 
-def save(ckpt_dir: str, name: str, state: TrainState, meta: dict) -> None:
+def _write_meta(ckpt_dir: str, name: str, meta: dict) -> None:
+    if jax.process_index() == 0:
+        with open(_meta_path(ckpt_dir, name), "w") as f:
+            json.dump(meta, f)
+
+
+def _flush_pending() -> None:
+    global _pending_meta
+    if _pending_meta is not None:
+        _write_meta(*_pending_meta)
+        _pending_meta = None
+
+
+def wait_until_finished() -> None:
+    """Block until any in-flight async save is durable (and its meta
+    sidecar written). Call before reading a just-written checkpoint and
+    at the end of a run."""
+    _checkpointer().wait_until_finished()
+    _flush_pending()
+
+
+def save(ckpt_dir: str, name: str, state: TrainState, meta: dict,
+         block: bool = True) -> None:
     """Write checkpoint + sidecar metadata. Multi-host safe: Orbax
-    coordinates across processes; the JSON sidecar is process-0 only."""
+    coordinates across processes; the JSON sidecar is process-0 only.
+    ``block=False`` returns after staging; the background finalize and
+    the meta write complete on the next save/wait (see module docstring).
+    """
+    global _pending_meta
     path = os.path.abspath(os.path.join(ckpt_dir, name))
-    ckptr = ocp.StandardCheckpointer()
+    ckptr = _checkpointer()
+    # Only one save may be in flight; landing the previous one also
+    # flushes its meta in the correct order.
+    ckptr.wait_until_finished()
+    _flush_pending()
     # Hand Orbax the jax.Arrays as-is: it gathers sharded leaves itself
     # (a tensor-parallel state spans hosts — a host-side device_get here
     # would crash on non-addressable shards).
     ckptr.save(path, state, force=True)
-    ckptr.wait_until_finished()
-    if jax.process_index() == 0:
-        with open(_meta_path(ckpt_dir, name), "w") as f:
-            json.dump(meta, f)
+    if block:
+        ckptr.wait_until_finished()
+        _write_meta(ckpt_dir, name, meta)
+    else:
+        _pending_meta = (ckpt_dir, name, meta)
 
 
 def restore(ckpt_dir: str, name: str,
             target: TrainState) -> tuple[TrainState, dict] | None:
     """Restore (state, meta) or None if absent. ``target`` supplies the
     tree structure/shapes (an abstract or concrete TrainState)."""
+    wait_until_finished()  # a just-written checkpoint must be durable
     path = os.path.abspath(os.path.join(ckpt_dir, name))
     if not os.path.isdir(path):
         return None

@@ -79,6 +79,9 @@ class Config:
     eval_every: int = 1  # validate every N epochs
     log_every: int = 50  # step-level stdout cadence on process 0
     profile: bool = False  # opt-in jax.profiler trace (SURVEY §5 tracing)
+    # Persistent XLA compilation cache dir ("" = off): restarted/resumed
+    # runs skip the first-step compile (~minutes for big models).
+    compile_cache: str = ""
     check_nans: bool = False  # debug flag (SURVEY §5 sanitizers)
 
     # ---- mesh geometry / parallelism strategies ----
@@ -194,6 +197,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval-every", type=int, default=c.eval_every)
     p.add_argument("--log-every", type=int, default=c.log_every)
     p.add_argument("--profile", action="store_true", default=False)
+    p.add_argument("--compile-cache", type=str, default=c.compile_cache,
+                   help="persistent XLA compilation cache directory")
     p.add_argument("--check-nans", action="store_true", default=False)
     p.add_argument("--model-parallel", type=int, default=c.model_parallel)
     p.add_argument("--seq-parallel", type=str, default=c.seq_parallel,

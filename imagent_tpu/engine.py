@@ -22,6 +22,7 @@ the reference's ``torch.cuda.synchronize()`` every step
 from __future__ import annotations
 
 import itertools
+import os
 import signal
 import time
 
@@ -214,6 +215,10 @@ def run(cfg: Config, stop_check=None) -> dict:
     senv = cluster.initialize(cfg.backend or None)
     if stop_check is None:
         stop_check = PreemptionGuard()
+    if cfg.compile_cache:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(cfg.compile_cache))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     print(cluster.rank_banner(senv), flush=True)
     is_master = jax.process_index() == 0
 
@@ -458,13 +463,16 @@ def run(cfg: Config, stop_check=None) -> dict:
                     "epoch": epoch, "best_top1": best_top1,
                     "best_top5": best_top5, "best_epoch": best_epoch})
         if cfg.save_model:
+            # Async: the next epoch trains while LAST serializes.
             ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.LAST, state, {
                 "epoch": epoch, "best_top1": best_top1,
-                "best_top5": best_top5, "best_epoch": best_epoch})
+                "best_top5": best_top5, "best_epoch": best_epoch},
+                block=False)
         logger.epoch_summary(epoch, lr, train_m,
                              val_m if did_eval else None, train_t, val_t)
         logger.scalars(epoch, lr, train_m, val_m if did_eval else None)
 
+    ckpt_lib.wait_until_finished()  # land any in-flight async save
     if cfg.profile and is_master:
         jax.profiler.stop_trace()
     total_min = (time.time() - run_t0) / 60.0

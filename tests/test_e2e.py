@@ -78,3 +78,21 @@ def test_e2e_eval_only(tmp_path):
     result = run(cfg2)
     assert result["final_val"]["n"] > 0
     assert result["final_train"]["top1"] == 0.0  # nothing trained
+
+
+def test_e2e_compile_cache_and_async_ckpt(tmp_path):
+    """--compile-cache populates the persistent XLA cache; async LAST
+    saves land durably (meta written only after finalize) and resume."""
+    cache = tmp_path / "xla_cache"
+    cfg = _tiny_cfg(tmp_path, epochs=2, save_model=True,
+                    compile_cache=str(cache))
+    run(cfg)
+    assert cache.is_dir() and any(cache.iterdir())  # cache written
+    meta = (tmp_path / "ckpt" / "last_meta.json")
+    assert meta.exists()
+    import json
+    assert json.loads(meta.read_text())["epoch"] == 1
+    cfg2 = _tiny_cfg(tmp_path, epochs=3, save_model=True, resume=True,
+                     compile_cache=str(cache))
+    result = run(cfg2)
+    assert result["best_epoch"] >= 0
