@@ -11,11 +11,11 @@ that gap: the full ``{params, batch_stats, opt_state, step}`` bundle plus
 Async saves: Orbax's ``StandardCheckpointer`` stages (device→host) and
 finalizes in a background thread. ``save(..., block=False)`` returns as
 soon as staging is done — training overlaps the serialization of the
-per-epoch LAST checkpoint. Correctness rule: the JSON meta sidecar is
-written only AFTER its checkpoint data is durably finalized (Orbax's
-atomic rename), so a kill mid-save can never leave meta describing
-newer state than the directory holds — the pending meta is flushed by
-the next ``save``/``wait_until_finished`` call.
+per-epoch LAST checkpoint. Correctness rule: the metadata is stored
+INSIDE the Orbax pytree (scalar leaves), so it is atomic with the state
+under Orbax's rename — a kill at any moment leaves a directory whose
+meta always describes exactly the weights it holds. The JSON sidecar is
+advisory (human inspection only; restore reads the in-tree meta).
 """
 
 from __future__ import annotations
@@ -32,6 +32,15 @@ from imagent_tpu.train import TrainState
 
 BEST = "best"
 LAST = "last"
+
+# Meta scalars stored inside the checkpoint tree (atomic with the state).
+_META_FIELDS = (
+    ("epoch", np.int64, -1),
+    ("best_top1", np.float64, 0.0),
+    ("best_top5", np.float64, 0.0),
+    ("best_epoch", np.int64, -1),
+    ("resume_step", np.int64, 0),
+)
 
 _ckptr: ocp.StandardCheckpointer | None = None
 _pending_meta: tuple[str, str, dict] | None = None
@@ -80,13 +89,17 @@ def save(ckpt_dir: str, name: str, state: TrainState, meta: dict,
     path = os.path.abspath(os.path.join(ckpt_dir, name))
     ckptr = _checkpointer()
     # Only one save may be in flight; landing the previous one also
-    # flushes its meta in the correct order.
+    # flushes its sidecar in the correct order.
     ckptr.wait_until_finished()
     _flush_pending()
     # Hand Orbax the jax.Arrays as-is: it gathers sharded leaves itself
     # (a tensor-parallel state spans hosts — a host-side device_get here
-    # would crash on non-addressable shards).
-    ckptr.save(path, state, force=True)
+    # would crash on non-addressable shards). Meta rides in-tree so it
+    # is atomic with the weights.
+    tree = {"state": state,
+            "meta": {k: np.asarray(meta.get(k, default), dtype)
+                     for k, dtype, default in _META_FIELDS}}
+    ckptr.save(path, tree, force=True)
     if block:
         ckptr.wait_until_finished()
         _write_meta(ckpt_dir, name, meta)
@@ -103,12 +116,12 @@ def restore(ckpt_dir: str, name: str,
     if not os.path.isdir(path):
         return None
     ckptr = ocp.StandardCheckpointer()
-    abstract = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), target)
-    state = ckptr.restore(path, abstract)
-    meta: dict[str, Any] = {}
-    mp = _meta_path(ckpt_dir, name)
-    if os.path.exists(mp):
-        with open(mp) as f:
-            meta = json.load(f)
-    return state, meta
+    abstract = {
+        "state": jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), target),
+        "meta": {k: jax.ShapeDtypeStruct((), dtype)
+                 for k, dtype, _ in _META_FIELDS},
+    }
+    tree = ckptr.restore(path, abstract)
+    meta: dict[str, Any] = {k: v.item() for k, v in tree["meta"].items()}
+    return tree["state"], meta

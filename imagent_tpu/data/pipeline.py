@@ -96,18 +96,23 @@ def pad_batch(images: np.ndarray, labels: np.ndarray,
 
 
 def make_loaders(cfg: Config, process_index: int, process_count: int,
-                 global_batch: int) -> tuple["Loader", "Loader"]:
-    """Build (train_loader, val_loader) per ``cfg.dataset``."""
+                 global_batch: int,
+                 skip_train: bool = False) -> tuple["Loader", "Loader"]:
+    """Build (train_loader, val_loader) per ``cfg.dataset``.
+
+    ``skip_train`` (--eval-only) returns ``None`` for the train loader —
+    scanning a 1.28M-file train split just to discard it costs minutes.
+    """
     if cfg.dataset == "synthetic":
         from imagent_tpu.data.synthetic import SyntheticLoader
-        train = SyntheticLoader(cfg, process_index, process_count,
-                                global_batch, train=True)
+        train = None if skip_train else SyntheticLoader(
+            cfg, process_index, process_count, global_batch, train=True)
         val = SyntheticLoader(cfg, process_index, process_count,
                               global_batch, train=False)
         return train, val
     from imagent_tpu.data.imagefolder import ImageFolderLoader
-    train = ImageFolderLoader(cfg, process_index, process_count,
-                              global_batch, split="train")
+    train = None if skip_train else ImageFolderLoader(
+        cfg, process_index, process_count, global_batch, split="train")
     val = ImageFolderLoader(cfg, process_index, process_count,
                             global_batch, split="val")
     return train, val

@@ -277,7 +277,8 @@ def run(cfg: Config, stop_check=None) -> dict:
                          "shard_map strategies, --zero1, or --grad-accum")
 
     train_loader, val_loader = make_loaders(
-        cfg, jax.process_index(), jax.process_count(), global_batch)
+        cfg, jax.process_index(), jax.process_count(), global_batch,
+        skip_train=cfg.eval_only)
 
     if use_sp:
         model = create_model(
@@ -422,6 +423,8 @@ def run(cfg: Config, stop_check=None) -> dict:
             print(f"eval-only: val loss {val_m['loss']:.4f} "
                   f"top1 {val_m['top1']:.3f} top5 {val_m['top5']:.3f} "
                   f"({val_m['n']} samples, {val_t:.1f}s)", flush=True)
+        if cfg.profile and is_master:
+            jax.profiler.stop_trace()
         logger.close()
         return {"best_top1": val_m["top1"], "best_top5": val_m["top5"],
                 "best_epoch": start_epoch - 1,

@@ -260,9 +260,9 @@ VIT_REGISTRY = {
                     num_heads=16, mlp_dim=5120),
 }
 
-# torchvision reference param counts at 1000 classes (vit_h_14 at its
-# torchvision-default 518px pos-embedding uses 224px here: count below
-# is for 224px input, matching this module's init geometry).
+# torchvision reference param counts at 1000 classes (no vit_h14 entry:
+# torchvision publishes vit_h_14 only at 518px pos-embedding geometry,
+# which doesn't match this module's init size).
 VIT_PARAM_COUNTS = {
     "vit_b16": 86_567_656,
     "vit_l16": 304_326_632,

@@ -27,8 +27,16 @@ def _clean_env():
     return env
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def test_two_process_train_step_matches_single():
-    port = 29871
+    port = _free_port()
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.join(_DIR, "mp_worker.py"),
@@ -37,7 +45,12 @@ def test_two_process_train_step_matches_single():
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for rank in (0, 1)
     ]
-    outs = [p.communicate(timeout=300)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
 
