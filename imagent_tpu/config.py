@@ -59,7 +59,7 @@ class Config:
     # (SURVEY §0: Resize+Normalize only, hence its 63% top-1); required for
     # the north-star accuracy config (BASELINE.md).
     augment: bool = False
-    dataset: str = "imagefolder"  # imagefolder | synthetic
+    dataset: str = "imagefolder"  # imagefolder | tar | synthetic
     synthetic_size: int = 2048  # images per epoch in synthetic mode
     bf16: bool = True  # bfloat16 compute on the MXU
     # Emit bf16 image batches from the input pipeline: halves the
@@ -179,7 +179,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="RandomResizedCrop+hflip train augmentation "
                         "(reference parity is OFF)")
     p.add_argument("--dataset", type=str, default=c.dataset,
-                   choices=["imagefolder", "synthetic"])
+                   choices=["imagefolder", "tar", "synthetic"],
+                   help="tar = {train,val}/*.tar shards (webdataset-style "
+                        "class-dir members)")
     p.add_argument("--synthetic-size", type=int, default=c.synthetic_size)
     p.add_argument("--no-bf16", dest="bf16", action="store_false",
                    default=True)

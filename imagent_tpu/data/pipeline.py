@@ -110,9 +110,11 @@ def make_loaders(cfg: Config, process_index: int, process_count: int,
         val = SyntheticLoader(cfg, process_index, process_count,
                               global_batch, train=False)
         return train, val
-    from imagent_tpu.data.imagefolder import ImageFolderLoader
-    train = None if skip_train else ImageFolderLoader(
+    if cfg.dataset == "tar":
+        from imagent_tpu.data.tarshards import TarShardLoader as Cls
+    else:
+        from imagent_tpu.data.imagefolder import ImageFolderLoader as Cls
+    train = None if skip_train else Cls(
         cfg, process_index, process_count, global_batch, split="train")
-    val = ImageFolderLoader(cfg, process_index, process_count,
-                            global_batch, split="val")
+    val = Cls(cfg, process_index, process_count, global_batch, split="val")
     return train, val
