@@ -202,8 +202,19 @@ def make_mesh(model_parallel: int = 1,
         raise ValueError(
             f"device count {devs.size} not divisible by model_parallel"
             f"={model_parallel} x pipeline_parallel={pipeline_parallel}")
-    grid = devs.reshape(devs.size // per_replica, pipeline_parallel,
-                        model_parallel)
+    shape = (devs.size // per_replica, pipeline_parallel, model_parallel)
+    if devices is None:
+        # Topology-aware assignment: on real pods this places the inner
+        # (model, pipe) axes on physically adjacent chips so their
+        # collectives take single ICI hops; correctness never depends on
+        # the order (batch rows may land on any device), only locality.
+        try:
+            from jax.experimental import mesh_utils
+            return Mesh(mesh_utils.create_device_mesh(shape),
+                        (DATA_AXIS, PIPE_AXIS, MODEL_AXIS))
+        except (ImportError, ValueError, AssertionError):
+            pass  # unusual topology: fall through to the naive order
+    grid = devs.reshape(shape)
     return Mesh(grid, (DATA_AXIS, PIPE_AXIS, MODEL_AXIS))
 
 
