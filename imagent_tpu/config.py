@@ -109,6 +109,7 @@ class Config:
     capacity_factor: float = 1.25
     expert_parallel: bool = False
     moe_aux_weight: float = 0.01  # Switch load-balancing loss weight
+    moe_top_k: int = 1  # router choices per token (1=Switch, 2=GShard)
     # FSDP (ZeRO-3): params + momentum fully sharded over the data axis
     # via the XLA SPMD partitioner (parallel/fsdp.py) — plain jit with
     # shardings, XLA inserts per-layer all-gathers/reduce-scatters.
@@ -219,6 +220,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--expert-parallel", action="store_true", default=False,
                    help="shard MoE experts over the model axis (all_to_all)")
     p.add_argument("--moe-aux-weight", type=float, default=c.moe_aux_weight)
+    p.add_argument("--moe-top-k", type=int, default=c.moe_top_k,
+                   help="router choices per token (1=Switch, 2=GShard)")
     p.add_argument("--fsdp", action="store_true", default=False,
                    help="fully shard params+optimizer over the data axis "
                         "(XLA SPMD partitioner)")

@@ -290,7 +290,7 @@ def run(cfg: Config, stop_check=None) -> dict:
     elif cfg.moe_every:
         moe_kw = dict(moe_every=cfg.moe_every, num_experts=cfg.num_experts,
                       capacity_factor=cfg.capacity_factor,
-                      moe_groups=cfg.moe_groups)
+                      moe_groups=cfg.moe_groups, moe_top_k=cfg.moe_top_k)
         model = create_model(
             cfg.arch, cfg.num_classes, cfg.bf16, attn_impl=cfg.attn,
             expert_axis=cluster.MODEL_AXIS if use_ep else None, **moe_kw, remat=cfg.remat)

@@ -103,6 +103,7 @@ class EncoderBlock(nn.Module):
     num_experts: int = 8
     capacity_factor: float = 1.25
     moe_groups: int = 1
+    moe_top_k: int = 1
     expert_axis: str | None = None
 
     @nn.compact
@@ -121,7 +122,8 @@ class EncoderBlock(nn.Module):
             return x + MoEMLP(
                 self.mlp_dim, num_experts=self.num_experts,
                 capacity_factor=self.capacity_factor,
-                groups=self.moe_groups, expert_axis=self.expert_axis,
+                groups=self.moe_groups, top_k=self.moe_top_k,
+                expert_axis=self.expert_axis,
                 dtype=self.dtype, name="moe")(y)
         tp = 1
         if self.tp_axis is not None:
@@ -176,6 +178,7 @@ class VisionTransformer(nn.Module):
     num_experts: int = 8
     capacity_factor: float = 1.25
     moe_groups: int = 1           # capacity groups in the unsharded twin
+    moe_top_k: int = 1            # 1 = Switch; 2 = GShard top-2
     expert_axis: str | None = None  # mesh axis for expert parallelism
     remat: bool = False  # jax.checkpoint each block (recompute on bwd)
 
@@ -236,6 +239,7 @@ class VisionTransformer(nn.Module):
                               moe=moe, num_experts=self.num_experts,
                               capacity_factor=self.capacity_factor,
                               moe_groups=self.moe_groups,
+                              moe_top_k=self.moe_top_k,
                               expert_axis=self.expert_axis,
                               name=f"encoder_layer_{i}")(x)
         x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="ln")(x)

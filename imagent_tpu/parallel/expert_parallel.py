@@ -51,31 +51,49 @@ from jax.sharding import PartitionSpec as P
 from imagent_tpu.cluster import MODEL_AXIS
 
 
-def _dispatch_combine(gates: jnp.ndarray, capacity: int):
-    """Top-1 (Switch) dispatch/combine tensors for one token group.
+def _dispatch_combine(gates: jnp.ndarray, capacity: int,
+                      top_k: int = 1):
+    """Top-k dispatch/combine tensors for one token group (k=1 =
+    Switch; k=2 = GShard's standard routing).
 
     gates: [T, E] softmax router probabilities, float32. All position
     arithmetic stays in float32 regardless of the model dtype: a bf16
     cumsum cannot represent queue positions above 256, which would
     silently collapse distinct tokens into one capacity slot at
     realistic token counts.
-    Returns (dispatch [T, E, C] one-hot, combine [T, E, C] weighted),
+    Returns (dispatch [T, E, C] {0,1}, combine [T, E, C] weighted),
     float32 — caller casts for the MXU einsums (0/1 and gate weights
     are bf16-safe values).
     A token's slot in its expert's queue is a cumsum over the one-hot
-    assignment (arrival order); tokens past ``capacity`` get a zero
-    dispatch row and ride the residual connection.
+    assignment (arrival order); choice round r's slots start after ALL
+    of round r-1's assignments (GShard ordering, so second choices are
+    the ones dropped under pressure). Tokens past ``capacity`` get a
+    zero dispatch row for that choice and ride the residual. For k>1
+    the combine weights renormalize over the chosen experts.
     """
     gates = gates.astype(jnp.float32)
-    idx = jnp.argmax(gates, axis=-1)                      # [T]
-    prob = jnp.max(gates, axis=-1)                        # [T]
-    onehot = jax.nn.one_hot(idx, gates.shape[-1], dtype=jnp.float32)
-    pos = jnp.cumsum(onehot, axis=0) * onehot             # [T, E], 1-based
-    keep = ((pos > 0) & (pos <= capacity)).astype(jnp.float32)
-    slot = jnp.clip(pos - 1, 0, capacity - 1).astype(jnp.int32)
-    disp = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)  # [T, E, C]
-    disp = disp * keep[..., None]
-    combine = prob[:, None, None] * disp
+    e = gates.shape[-1]
+    masks, probs = [], []
+    g = gates
+    for _ in range(top_k):
+        onehot = jax.nn.one_hot(jnp.argmax(g, -1), e, dtype=jnp.float32)
+        masks.append(onehot)
+        probs.append(jnp.max(g, axis=-1))
+        g = g * (1.0 - onehot)  # a token never picks the same expert twice
+    if top_k > 1:
+        denom = sum(probs) + 1e-9
+        probs = [p / denom for p in probs]
+    disp = combine = 0.0
+    occupancy = jnp.zeros((e,), jnp.float32)  # slots used by prior rounds
+    for m, p in zip(masks, probs):
+        pos = (jnp.cumsum(m, axis=0) + occupancy) * m     # [T, E], 1-based
+        keep = ((pos > 0) & (pos <= capacity)).astype(jnp.float32)
+        slot = jnp.clip(pos - 1, 0, capacity - 1).astype(jnp.int32)
+        d = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)  # [T, E, C]
+        d = d * keep[..., None]
+        disp = disp + d
+        combine = combine + p[:, None, None] * d
+        occupancy = occupancy + jnp.sum(m, axis=0)
     return disp, combine
 
 
@@ -95,6 +113,7 @@ class MoEMLP(nn.Module):
     groups: int = 1
     expert_axis: str | None = None
     dtype: Any = jnp.float32
+    top_k: int = 1  # 1 = Switch; 2 = GShard standard top-2
 
     @nn.compact
     def __call__(self, x):
@@ -139,7 +158,8 @@ class MoEMLP(nn.Module):
             grp = tokens.reshape(groups, t_group, d)
             gates, aux = jax.vmap(gate)(grp)
             disp, comb = jax.vmap(
-                lambda gg: _dispatch_combine(gg, capacity))(gates)
+                lambda gg: _dispatch_combine(gg, capacity,
+                                             self.top_k))(gates)
             disp, comb = disp.astype(self.dtype), comb.astype(self.dtype)
             ein = jnp.einsum("gtd,gtec->gecd", grp, disp)
             h = nn.gelu(jnp.einsum("gecd,edh->gech", ein, wi),
@@ -153,7 +173,8 @@ class MoEMLP(nn.Module):
         shard = lax.axis_index(self.expert_axis)
         local = lax.dynamic_slice_in_dim(tokens, shard * t_group, t_group, 0)
         gates, aux = gate(local)
-        disp, comb = _dispatch_combine(gates, capacity)      # [T, E, C]
+        disp, comb = _dispatch_combine(gates, capacity,
+                                       self.top_k)       # [T, E, C]
         disp, comb = disp.astype(self.dtype), comb.astype(self.dtype)
         ein = jnp.einsum("td,tec->ecd", local, disp)         # [E, C, D]
         # Route slot tensors to their expert's owner shard: split the

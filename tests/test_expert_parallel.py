@@ -170,3 +170,53 @@ def test_ep_expert_divisibility_fails_loudly():
             mesh, rng.normal(size=(8, SIZE, SIZE, 3)).astype(np.float32),
             np.zeros((8,), np.int32))
         step(state, gi, gl, np.float32(0.1))
+
+
+def test_top2_dispatch_accounting():
+    """Top-2: every token dispatched to exactly 2 distinct experts
+    (ample capacity), each slot holds at most one token, and combine
+    weights renormalize over the chosen pair."""
+    import jax.numpy as jnp
+
+    from imagent_tpu.parallel.expert_parallel import _dispatch_combine
+
+    rng = np.random.default_rng(4)
+    t, e = 500, 8
+    gates = jax.nn.softmax(
+        jnp.asarray(rng.normal(size=(t, e)), jnp.float32), axis=-1)
+    disp, comb = _dispatch_combine(gates, capacity=t, top_k=2)
+    d = np.asarray(disp)
+    assert d.sum() == 2 * t                       # two choices per token
+    per_token_experts = (d.sum(axis=2) > 0).sum(axis=1)
+    assert (per_token_experts == 2).all()         # distinct experts
+    assert d.sum(axis=0).max() <= 1.0 + 1e-6      # slot uniqueness
+    w = np.asarray(comb).sum(axis=(1, 2))
+    np.testing.assert_allclose(w, 1.0, atol=1e-5)  # renormalized pair
+
+
+def test_ep_top2_matches_unsharded(data):
+    """EP with top-2 routing still matches the unsharded twin."""
+    images, labels = data
+    ep = 2
+    cfgkw = {**TINY, "moe_top_k": 2}
+    mesh1 = make_mesh(model_parallel=1, devices=jax.devices()[:1])
+    model_ref = VisionTransformer(**cfgkw, moe_groups=(8 // ep) * ep)
+    opt = make_optimizer()
+    # Host copy: both steps donate their input state.
+    state = jax.device_get(create_train_state(
+        VisionTransformer(**cfgkw), jax.random.key(0), SIZE, opt))
+    ref_step = make_train_step(model_ref, opt, mesh1)
+    gi, gl = shard_batch(mesh1, images, labels)
+    _, ref_metrics = ref_step(replicate_state(state, mesh1), gi, gl,
+                              np.float32(0.1))
+
+    mesh = make_mesh(model_parallel=ep)
+    model_ep = VisionTransformer(**cfgkw, expert_axis=MODEL_AXIS)
+    specs = state_partition_specs(state, vit_moe_param_specs(state.params))
+    state_ep = place_state(state, mesh, specs)
+    step = make_train_step(model_ep, opt, mesh, state_specs=specs,
+                           expert_parallel=True)
+    gi, gl = shard_batch(mesh, images, labels)
+    _, metrics = step(state_ep, gi, gl, np.float32(0.1))
+    np.testing.assert_allclose(np.asarray(metrics), np.asarray(ref_metrics),
+                               rtol=1e-4, atol=1e-4)
