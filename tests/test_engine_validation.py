@@ -1,0 +1,43 @@
+"""Engine config validation: every invalid flag combination fails
+loudly BEFORE any data loading or compilation — these branches guard
+operators from silently-wrong runs."""
+
+import pytest
+
+from imagent_tpu.config import Config
+from imagent_tpu.engine import run
+
+
+def _cfg(**kw):
+    base = dict(arch="resnet18", image_size=16, num_classes=4, batch_size=4,
+                epochs=1, dataset="synthetic", synthetic_size=32, workers=0,
+                bf16=False, log_every=0, backend="cpu")
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.mark.parametrize("kw,match", [
+    (dict(grad_accum=0), "--grad-accum"),
+    (dict(seq_parallel="ring"), "--seq-parallel requires"),
+    (dict(attn="flash"), "--attn.*requires a ViT"),
+    (dict(arch="vit_b16", attn="flash", seq_parallel="ring",
+          model_parallel=2), "mutually exclusive"),
+    (dict(tensor_parallel=True), "--tensor-parallel requires"),
+    (dict(arch="vit_b16", tensor_parallel=True, seq_parallel="ring",
+          model_parallel=2), "pick one"),
+    (dict(pipeline_parallel=2), "--pipeline-parallel requires a ViT"),
+    (dict(arch="vit_b16", pipeline_parallel=2, seq_parallel="ring",
+          model_parallel=2), "--pipeline-parallel with --seq-parallel"),
+    (dict(moe_every=2), "--moe-every requires a ViT"),
+    (dict(arch="vit_b16", moe_every=2, tensor_parallel=True,
+          model_parallel=2), "MoE composes"),
+    (dict(arch="vit_b16", expert_parallel=True), "--expert-parallel"),
+    (dict(zero1=True, model_parallel=2, arch="vit_b16",
+          tensor_parallel=True), "--zero1"),
+    (dict(fsdp=True, zero1=True), "--fsdp"),
+    (dict(fsdp=True, grad_accum=2), "--fsdp"),
+    (dict(zero1=True, optimizer="adamw"), "--zero1 implements"),
+])
+def test_invalid_combinations_rejected(kw, match):
+    with pytest.raises(ValueError, match=match):
+        run(_cfg(**kw))
