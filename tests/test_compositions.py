@@ -1,0 +1,57 @@
+"""Cross-feature compositions execute end-to-end: the Pallas flash
+kernel inside pipeline stages, and rematerialization under ring
+sequence-parallelism — combinations a user will reach for together."""
+
+import jax
+import numpy as np
+
+from imagent_tpu.cluster import MODEL_AXIS, PIPE_AXIS, make_mesh
+from imagent_tpu.models.vit import VisionTransformer
+from imagent_tpu.parallel.pipeline import vit_pp_param_specs
+from imagent_tpu.train import (
+    create_train_state, make_optimizer, make_train_step, place_state,
+    replicate_state, shard_batch, state_partition_specs,
+)
+
+TINY = dict(patch_size=8, hidden_dim=32, num_layers=4, num_heads=4,
+            mlp_dim=64, num_classes=8)
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    return (rng.normal(size=(8, 32, 32, 3)).astype(np.float32),
+            rng.integers(0, 8, size=(8,)).astype(np.int32))
+
+
+def test_pipeline_with_flash_attention():
+    images, labels = _data()
+    opt = make_optimizer()
+    mesh = make_mesh(pipeline_parallel=4)
+    model = VisionTransformer(**TINY, pipe_axis=PIPE_AXIS, microbatches=2,
+                              attn_impl="flash")
+    init_model = VisionTransformer(**TINY, stacked=True)
+    st = create_train_state(init_model, jax.random.key(0), 32, opt)
+    specs = state_partition_specs(st, vit_pp_param_specs(st.params))
+    st = place_state(st, mesh, specs)
+    step = make_train_step(model, opt, mesh, state_specs=specs,
+                           pipe_axis=PIPE_AXIS)
+    gi, gl = shard_batch(mesh, images, labels)
+    _, m = step(st, gi, gl, np.float32(0.1))
+    m = np.asarray(m)
+    assert m.shape == (4,) and m[3] == 8 and np.isfinite(m[0])
+
+
+def test_ring_attention_with_remat():
+    images, labels = _data()
+    opt = make_optimizer()
+    mesh = make_mesh(model_parallel=2)
+    model = VisionTransformer(**TINY, gap_readout=True, attn_impl="ring",
+                              seq_axis=MODEL_AXIS, remat=True)
+    init_model = VisionTransformer(**TINY, gap_readout=True, remat=True)
+    st = replicate_state(
+        create_train_state(init_model, jax.random.key(0), 32, opt), mesh)
+    step = make_train_step(model, opt, mesh, seq_parallel=True)
+    gi, gl = shard_batch(mesh, images, labels)
+    _, m = step(st, gi, gl, np.float32(0.1))
+    m = np.asarray(m)
+    assert m.shape == (4,) and m[3] == 8 and np.isfinite(m[0])
