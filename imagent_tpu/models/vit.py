@@ -105,6 +105,7 @@ class EncoderBlock(nn.Module):
     moe_groups: int = 1
     moe_top_k: int = 1
     expert_axis: str | None = None
+    moe_sow_aux: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -123,7 +124,7 @@ class EncoderBlock(nn.Module):
                 self.mlp_dim, num_experts=self.num_experts,
                 capacity_factor=self.capacity_factor,
                 groups=self.moe_groups, top_k=self.moe_top_k,
-                expert_axis=self.expert_axis,
+                expert_axis=self.expert_axis, sow_aux=self.moe_sow_aux,
                 dtype=self.dtype, name="moe")(y)
         tp = 1
         if self.tp_axis is not None:
@@ -217,15 +218,23 @@ class VisionTransformer(nn.Module):
 
         block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         if self.stacked or self.pipe_axis is not None:
-            if self.moe_every:
+            if self.moe_every not in (0, 1):
                 raise ValueError(
-                    "MoE is not supported on the stacked/pipelined encoder "
-                    "(heterogeneous layers break the nn.scan stack)")
+                    "the stacked/pipelined encoder needs homogeneous "
+                    "layers: MoE requires --moe-every=1 there")
             from imagent_tpu.parallel.pipeline import Pipeline
+            moe_kw = {}
+            if self.moe_every == 1:
+                moe_kw = dict(moe=True, num_experts=self.num_experts,
+                              capacity_factor=self.capacity_factor,
+                              moe_groups=self.moe_groups,
+                              moe_top_k=self.moe_top_k,
+                              expert_axis=self.expert_axis,
+                              moe_sow_aux=False)
             body = partial(block_cls, self.num_heads, self.mlp_dim,
                            dtype=self.dtype, attn_impl=self.attn_impl,
                            seq_axis=self.seq_axis, tp_axis=self.tp_axis,
-                           name="block")
+                           name="block", **moe_kw)
             x = Pipeline(body=body, num_layers=self.num_layers,
                          pipe_axis=self.pipe_axis,
                          microbatches=self.microbatches, name="encoder")(x)

@@ -114,6 +114,10 @@ class MoEMLP(nn.Module):
     expert_axis: str | None = None
     dtype: Any = jnp.float32
     top_k: int = 1  # 1 = Switch; 2 = GShard standard top-2
+    # Sow the load-balancing aux loss (off inside nn.scan'd pipeline
+    # stages: scanned collections would need axis declarations and the
+    # schedule's warmup/drain ticks would pollute the estimate).
+    sow_aux: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -166,7 +170,8 @@ class MoEMLP(nn.Module):
                         approximate=False)
             out = jnp.einsum("gech,ehd->gecd", h, wo)
             y = jnp.einsum("gecd,gtec->gtd", out, comb)
-            self.sow("intermediates", "moe_aux_loss", jnp.mean(aux))
+            if self.sow_aux:
+                self.sow("intermediates", "moe_aux_loss", jnp.mean(aux))
             return y.reshape(b, n, d)
 
         # ---- expert-parallel path (inside shard_map) ----
@@ -191,8 +196,9 @@ class MoEMLP(nn.Module):
         out = out.reshape(e, capacity, d)
         y = jnp.einsum("ecd,tec->td", out, comb)             # [T, D]
         y = lax.all_gather(y, self.expert_axis, axis=0, tiled=True)
-        self.sow("intermediates", "moe_aux_loss",
-                 lax.pmean(aux, self.expert_axis))
+        if self.sow_aux:
+            self.sow("intermediates", "moe_aux_loss",
+                     lax.pmean(aux, self.expert_axis))
         return y.reshape(b, n, d)
 
 

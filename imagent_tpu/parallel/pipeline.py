@@ -168,21 +168,27 @@ class Pipeline(nn.Module):
 
 
 def vit_pp_param_specs(params, pipe_axis: str = PIPE_AXIS,
-                       tp_axis: str | None = None):
+                       tp_axis: str | None = None,
+                       expert_axis: str | None = None):
     """PartitionSpec tree for a pipelined ViT param tree.
 
     Leaves under the ``pipe_layers`` scope are the layer-stacked encoder
     params: dim 0 (the layer dim) shards over ``pipe_axis``; with
     ``tp_axis`` also given, the head/MLP dims additionally shard
     Megatron-style (``vit_tp_param_specs`` rules shifted by the stack
-    dim) — a full 3-D (data, pipe, model) layout. Everything outside the
-    stack (patchify, position embeddings, final LN, head) is replicated.
+    dim); with ``expert_axis``, MoE expert stacks (``wi``/``wo``,
+    shapes ``[L, E, ...]``) additionally shard their expert dim — the
+    pp x ep composition. Everything outside the stack (patchify,
+    position embeddings, final LN, head) is replicated.
     """
 
     def spec(path, leaf):
         keys = [p.key for p in path if hasattr(p, "key")]
         if "pipe_layers" not in keys:
             return P()
+        name_ = keys[-1] if keys else ""
+        if expert_axis is not None and name_ in ("wi", "wo"):
+            return P(pipe_axis, expert_axis)  # [L, E, ...]
         if tp_axis is None:
             return P(pipe_axis)
         parent = keys[-2] if len(keys) >= 2 else ""
