@@ -1,14 +1,21 @@
-"""Headline benchmark: ResNet-18 448x448 train-step throughput per chip.
+"""Headline benchmarks with MFU accounting.
 
-Mirrors the reference's run-of-record config (ResNet-18, 448x448,
-per-rank batch 128, SGD momentum 0.9 wd 1e-4 — BASELINE.md): the
-reference sustained 152.8 img/s/GPU on its 16-GPU cluster (derived from
-`imagent_sgd.out:14,278`). This measures the same per-chip quantity for
-the jitted SPMD train step on the local device(s), synthetic device-resident
-data (input pipeline excluded on both sides: the reference number is also
-compute-dominated at 10 workers/rank).
+Two configs, every round:
+  1. (primary, parsed) ResNet-18 448x448 b128/chip — mirrors the
+     reference's run-of-record (`imagent_sgd.out:14,278`; BASELINE.md:
+     152.8 img/s/GPU on its 16-GPU cluster).
+  2. ResNet-50 224x224 b256/chip — the north-star config
+     (BASELINE.json: >= 1200 img/s/chip).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Both measure the jitted SPMD train step on the local device(s) with
+synthetic device-resident data (input pipeline excluded; the honest
+end-to-end epoch number lives in benchmarks/e2e_epoch.py). Each metric
+carries `tflops_per_chip` (analytic model FLOPs: 3x forward,
+multiply-add = 2) and `mfu_pct` against the detected chip's bf16 peak —
+so the number is judged against the hardware, not just a 2019 GPU log.
+
+Prints ONE JSON line; the primary metric is the top-level object,
+the second config rides in "extra".
 """
 
 import json
@@ -18,10 +25,17 @@ import time
 import numpy as np
 
 BASELINE_IMG_S_PER_CHIP = 152.8  # reference img/s/GPU (BASELINE.md)
+NORTH_STAR_IMG_S_PER_CHIP = 1200.0  # BASELINE.json resnet50@224 target
 
 
-def main() -> int:
+def measure(arch: str, size: int, per_chip_batch: int,
+            optimizer: str = "sgd", bf16: bool = True,
+            windows: int = 3, iters: int = 10) -> dict:
+    """Shared measurement harness (also used by benchmarks/throughput.py):
+    jitted train step, synthetic device-resident batches, best-of-N
+    windows, analytic-FLOPs MFU."""
     import jax
+    import jax.numpy as jnp
 
     from imagent_tpu.cluster import make_mesh
     from imagent_tpu.models import create_model
@@ -29,15 +43,16 @@ def main() -> int:
         create_train_state, make_optimizer, make_train_step,
         replicate_state, shard_batch,
     )
+    from imagent_tpu.utils.flops import (
+        chip_peak_bf16_tflops, forward_flops, train_step_flops_per_image,
+    )
 
     n_chips = len(jax.devices())
-    per_chip_batch = 128  # reference per-rank batch (imagenet.py:443)
     batch = per_chip_batch * n_chips
-    size = 448
 
     mesh = make_mesh(model_parallel=1)
-    model = create_model("resnet18", num_classes=1000, bf16=True)
-    opt = make_optimizer()
+    model = create_model(arch, num_classes=1000, bf16=bf16)
+    opt = make_optimizer(name=optimizer)
     state = replicate_state(
         create_train_state(model, jax.random.key(0), size, opt,
                            batch_size=2), mesh)
@@ -47,8 +62,8 @@ def main() -> int:
     # bf16 inputs: the model computes in bf16 anyway (first op casts), and
     # feeding bf16 halves the input's HBM read per step (~+4% measured).
     # The real input pipeline can emit bf16 the same way.
-    import jax.numpy as jnp
-    images = rng.normal(size=(batch, size, size, 3)).astype(jnp.bfloat16)
+    dtype = jnp.bfloat16 if bf16 else np.float32
+    images = rng.normal(size=(batch, size, size, 3)).astype(dtype)
     labels = rng.integers(0, 1000, size=(batch,)).astype(np.int32)
     gi, gl = shard_batch(mesh, images, labels)
     lr = np.float32(0.1)
@@ -59,24 +74,45 @@ def main() -> int:
         state, metrics = step(state, gi, gl, lr)
     np.asarray(metrics)
 
-    # Best of 3 windows: the chip is behind a shared tunnel; the fastest
+    # Best of N windows: the chip is behind a shared tunnel; the fastest
     # window is the least-perturbed measurement of the same program.
-    iters, best_dt = 10, float("inf")
-    for _ in range(3):
+    best_dt = float("inf")
+    for _ in range(windows):
         t0 = time.perf_counter()
         for _ in range(iters):
             state, metrics = step(state, gi, gl, lr)
         np.asarray(metrics)  # sync: last step depends on the whole chain
         best_dt = min(best_dt, time.perf_counter() - t0)
 
-    img_s = batch * iters / best_dt
-    img_s_chip = img_s / n_chips
-    print(json.dumps({
-        "metric": "resnet18_448_train_throughput_per_chip",
+    img_s_chip = batch * iters / best_dt / n_chips
+    step_flops = train_step_flops_per_image(forward_flops(arch, size))
+    tflops_chip = img_s_chip * step_flops / 1e12
+    kind = jax.devices()[0].device_kind
+    peak = chip_peak_bf16_tflops(kind)
+    out = {
+        "metric": f"{arch}_{size}_train_throughput_per_chip",
         "value": round(img_s_chip, 2),
         "unit": "img/s/chip",
-        "vs_baseline": round(img_s_chip / BASELINE_IMG_S_PER_CHIP, 3),
-    }))
+        "tflops_per_chip": round(tflops_chip, 2),
+        "chip": kind,
+    }
+    if peak is not None:
+        out["mfu_pct"] = round(100.0 * tflops_chip / peak, 2)
+        out["chip_peak_bf16_tflops"] = peak
+    return out
+
+
+def main() -> int:
+    primary = measure("resnet18", 448, 128)
+    primary["vs_baseline"] = round(
+        primary["value"] / BASELINE_IMG_S_PER_CHIP, 3)
+
+    north_star = measure("resnet50", 224, 256)
+    north_star["vs_baseline"] = round(
+        north_star["value"] / NORTH_STAR_IMG_S_PER_CHIP, 3)
+
+    primary["extra"] = [north_star]
+    print(json.dumps(primary))
     return 0
 
 

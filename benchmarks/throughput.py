@@ -1,4 +1,4 @@
-"""Reproduce the README throughput table.
+"""Reproduce the README throughput table (any arch/size/batch/optimizer).
 
     python benchmarks/throughput.py --arch resnet18 --image-size 448 \
         --batch-size 128                       # the bench.py headline
@@ -7,10 +7,11 @@
     python benchmarks/throughput.py --arch vit_b16 --image-size 224 \
         --batch-size 256 --optimizer adamw
 
-Measures the jitted SPMD train step on the local device(s) with
-device-resident bf16 synthetic batches (input pipeline excluded, like
-the reference's derived number — BASELINE.md); prints one JSON line per
-run. Best-of-N windows, same methodology as bench.py.
+Thin CLI over ``bench.measure`` — one measurement harness (jitted SPMD
+train step, device-resident bf16 synthetic batches, best-of-N windows,
+analytic-FLOPs MFU) shared with the driver benchmark, so methodology
+can't drift between the two. Prints one JSON line per run including
+``tflops_per_chip`` / ``mfu_pct``.
 """
 
 from __future__ import annotations
@@ -19,9 +20,6 @@ import argparse
 import json
 import os
 import sys
-import time
-
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -40,55 +38,16 @@ def main() -> int:
                    default=True)
     a = p.parse_args()
 
-    import jax
-    import jax.numpy as jnp
+    from bench import measure
 
-    from imagent_tpu.cluster import make_mesh
-    from imagent_tpu.models import create_model
-    from imagent_tpu.train import (
-        create_train_state, make_optimizer, make_train_step,
-        replicate_state, shard_batch,
-    )
-
-    n_chips = len(jax.devices())
-    batch = a.batch_size * n_chips
-    mesh = make_mesh(model_parallel=1)
-    model = create_model(a.arch, num_classes=1000, bf16=a.bf16)
-    opt = make_optimizer(name=a.optimizer)
-    state = replicate_state(
-        create_train_state(model, jax.random.key(0), a.image_size, opt,
-                           batch_size=2), mesh)
-    step = make_train_step(model, opt, mesh)
-
-    rng = np.random.default_rng(0)
-    dtype = jnp.bfloat16 if a.bf16 else np.float32
-    images = rng.normal(
-        size=(batch, a.image_size, a.image_size, 3)).astype(dtype)
-    labels = rng.integers(0, 1000, size=(batch,)).astype(np.int32)
-    gi, gl = shard_batch(mesh, images, labels)
-    lr = np.float32(0.1)
-
-    for _ in range(3):  # warmup/compile
-        state, metrics = step(state, gi, gl, lr)
-    np.asarray(metrics)  # hard sync (axon: block_until_ready returns early)
-
-    best = float("inf")
-    for _ in range(a.windows):
-        t0 = time.perf_counter()
-        for _ in range(a.iters):
-            state, metrics = step(state, gi, gl, lr)
-        np.asarray(metrics)
-        best = min(best, time.perf_counter() - t0)
-
-    print(json.dumps({
-        "arch": a.arch, "image_size": a.image_size,
-        "per_chip_batch": a.batch_size, "optimizer": a.optimizer,
-        "bf16": a.bf16, "chips": n_chips,
-        "img_s_per_chip": round(batch * a.iters / best / n_chips, 2),
-    }))
+    out = measure(a.arch, a.image_size, a.batch_size,
+                  optimizer=a.optimizer, bf16=a.bf16,
+                  windows=a.windows, iters=a.iters)
+    out["optimizer"] = a.optimizer
+    out["bf16"] = a.bf16
+    print(json.dumps(out))
     return 0
 
 
 if __name__ == "__main__":
-    import sys
     sys.exit(main())

@@ -1,0 +1,132 @@
+"""Analytic FLOP accounting for MFU reporting.
+
+The reference never reports utilization — its 152.8 img/s/GPU
+(`imagent_sgd.out:14,278`) is only meaningful relative to its own
+hardware. For the TPU framework we report model FLOPs utilization:
+
+    MFU = achieved_flops_per_sec / chip_peak_bf16_flops_per_sec
+
+using *analytic* model FLOPs (the standard convention: conv + matmul
+multiply-adds counted as 2 FLOPs each; elementwise/BN/pool ignored),
+NOT XLA's executed-op count — so remat overhead counts against MFU
+rather than inflating it.
+
+A train step costs ~3x the forward pass (forward + 2 matmul-shaped
+passes in backward: grads w.r.t. activations and w.r.t. weights).
+With per-block rematerialization the *executed* FLOPs are ~4x forward,
+but MFU is conventionally quoted against the 3x model FLOPs; callers
+can pass ``remat=True`` to get the executed multiple instead.
+"""
+
+from __future__ import annotations
+
+from ..models.resnet import STAGE_SIZES
+
+# bf16 peak TFLOP/s per chip, by `jax.Device.device_kind`.
+# Public numbers: v4 275, v5e ("v5 lite") 197, v5p 459, v6e ("v6 lite",
+# Trillium) 918, v3 123 (2 cores), v2 45.
+CHIP_PEAK_BF16_TFLOPS = {
+    "TPU v2": 45.0,
+    "TPU v3": 123.0,
+    "TPU v4": 275.0,
+    "TPU v4 lite": 137.5,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v5": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def chip_peak_bf16_tflops(device_kind: str) -> float | None:
+    """Peak bf16 TFLOP/s for a device kind, or None if unknown."""
+    return CHIP_PEAK_BF16_TFLOPS.get(device_kind)
+
+
+def _conv_out(size: int, kernel: int, stride: int, pad: int) -> int:
+    return (size + 2 * pad - kernel) // stride + 1
+
+
+def resnet_forward_flops(arch: str, image_size: int,
+                         num_classes: int = 1000) -> int:
+    """Forward FLOPs per image for the torchvision-plan ResNets
+    (models/resnet.py): convs + fc, multiply-add = 2 FLOPs.
+
+    Sanity anchor: resnet50 @ 224 -> 4.09 GMACs (8.18 GFLOPs), the
+    widely published torchvision number.
+    """
+    stages = STAGE_SIZES[arch]
+    bottleneck = arch not in ("resnet18", "resnet34")
+    flops = 0
+    # conv1 7x7/2 pad 3, then 3x3/2 pad 1 maxpool
+    h = _conv_out(image_size, 7, 2, 3)
+    flops += 2 * 7 * 7 * 3 * 64 * h * h
+    h = _conv_out(h, 3, 2, 1)
+    cin = 64
+    for i, block_count in enumerate(stages):
+        f = 64 * 2 ** i
+        cout = f * (4 if bottleneck else 1)
+        for j in range(block_count):
+            stride = 2 if i > 0 and j == 0 else 1
+            h_in = h
+            h_out = _conv_out(h_in, 3, stride, 1)
+            if bottleneck:
+                # 1x1 reduce (full res: stride sits on the 3x3, v1.5)
+                flops += 2 * cin * f * h_in * h_in
+                flops += 2 * 3 * 3 * f * f * h_out * h_out
+                flops += 2 * f * cout * h_out * h_out
+            else:
+                flops += 2 * 3 * 3 * cin * f * h_out * h_out
+                flops += 2 * 3 * 3 * f * f * h_out * h_out
+            if stride != 1 or cin != cout:
+                flops += 2 * cin * cout * h_out * h_out  # downsample 1x1
+            cin = cout
+            h = h_out
+    flops += 2 * cin * num_classes  # fc
+    return flops
+
+
+def vit_forward_flops(image_size: int, patch_size: int, hidden_dim: int,
+                      num_layers: int, num_heads: int, mlp_dim: int,
+                      num_classes: int = 1000,
+                      cls_token: bool = True) -> int:
+    """Forward FLOPs per image for models/vit.py: patch embed +
+    L x (QKV, QK^T, AV, proj, MLP) + head. Multiply-add = 2 FLOPs."""
+    del num_heads  # head split doesn't change the FLOP count
+    n = (image_size // patch_size) ** 2 + (1 if cls_token else 0)
+    d, m = hidden_dim, mlp_dim
+    flops = 2 * n * (patch_size * patch_size * 3) * d  # patch embed
+    per_layer = (
+        2 * n * d * 3 * d      # QKV projections
+        + 2 * n * n * d        # QK^T
+        + 2 * n * n * d        # attn @ V
+        + 2 * n * d * d        # output proj
+        + 2 * n * d * m * 2    # MLP in + out
+    )
+    flops += num_layers * per_layer
+    flops += 2 * d * num_classes
+    return flops
+
+
+def forward_flops(arch: str, image_size: int,
+                  num_classes: int = 1000) -> int:
+    """Arch-generic forward FLOPs per image for any registry model name
+    (models/__init__.py): dispatches to the ResNet or ViT counter."""
+    if arch.startswith("vit"):
+        from ..models.vit import VIT_REGISTRY
+        if arch not in VIT_REGISTRY:
+            raise ValueError(f"unknown ViT arch {arch!r}")
+        return vit_forward_flops(image_size, num_classes=num_classes,
+                                 **VIT_REGISTRY[arch])
+    if arch not in STAGE_SIZES:
+        raise ValueError(f"unknown arch {arch!r}")
+    return resnet_forward_flops(arch, image_size, num_classes)
+
+
+def train_step_flops_per_image(forward_flops: int,
+                               remat: bool = False) -> int:
+    """Model FLOPs for one optimizer step, per image: 3x forward
+    (1 fwd + 2x in bwd); 4x when the executed count under full
+    rematerialization is wanted instead."""
+    return forward_flops * (4 if remat else 3)

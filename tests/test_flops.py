@@ -1,0 +1,51 @@
+"""Analytic FLOP accounting (utils/flops.py) pinned to published numbers.
+
+The MFU figures in bench.py are only as good as these counts; each arch
+is anchored to the widely published torchvision/fvcore MAC count.
+"""
+
+import pytest
+
+from imagent_tpu.utils.flops import (
+    chip_peak_bf16_tflops, resnet_forward_flops,
+    train_step_flops_per_image, vit_forward_flops,
+)
+
+# Published forward MACs at 224x224, 1000 classes (torchvision/fvcore).
+PUBLISHED_GMACS = {
+    "resnet18": 1.814,
+    "resnet34": 3.664,
+    "resnet50": 4.089,
+    "resnet101": 7.801,
+    "resnet152": 11.514,
+}
+
+
+@pytest.mark.parametrize("arch", sorted(PUBLISHED_GMACS))
+def test_resnet_flops_match_published(arch):
+    got = resnet_forward_flops(arch, 224) / 2e9  # GMACs
+    assert got == pytest.approx(PUBLISHED_GMACS[arch], rel=1e-3)
+
+
+def test_resnet_flops_scale_with_resolution():
+    # Conv FLOPs scale ~4x with 2x resolution (fc is negligible).
+    f224 = resnet_forward_flops("resnet18", 224)
+    f448 = resnet_forward_flops("resnet18", 448)
+    assert 3.9 < f448 / f224 < 4.1
+
+
+def test_vit_b16_flops():
+    # ViT-B/16 @ 224: ~17.6 GMACs published (incl. attention matmuls).
+    got = vit_forward_flops(224, 16, 768, 12, 12, 3072) / 2e9
+    assert got == pytest.approx(17.56, rel=0.01)
+
+
+def test_train_step_multiple():
+    assert train_step_flops_per_image(100) == 300
+    assert train_step_flops_per_image(100, remat=True) == 400
+
+
+def test_chip_peak_lookup():
+    assert chip_peak_bf16_tflops("TPU v5 lite") == 197.0
+    assert chip_peak_bf16_tflops("TPU v4") == 275.0
+    assert chip_peak_bf16_tflops("TPU imaginary") is None
