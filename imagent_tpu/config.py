@@ -72,6 +72,10 @@ class Config:
     # jax.checkpoint each residual/encoder block: recompute activations
     # on the backward pass — ~33% more FLOPs for O(depth) less HBM.
     remat: bool = False
+    # ResNet stem variant: "v1" (torchvision-exact 7x7/s2; required for
+    # --init-from-torch) or "s2d" (MLPerf-style space-to-depth 4x4/s1
+    # stem — measured lever table in docs/ROOFLINE.md).
+    stem: str = "v1"
     # Micro-batches accumulated per optimizer step inside the compiled
     # train step: effective global batch = batch_size * data_parallel * K.
     grad_accum: int = 1
@@ -193,6 +197,9 @@ def build_parser() -> argparse.ArgumentParser:
                    default=c.label_smoothing)
     p.add_argument("--remat", action="store_true", default=False,
                    help="rematerialize blocks on backward (less HBM)")
+    p.add_argument("--stem", default="v1", choices=["v1", "s2d"],
+                   help="ResNet stem: torchvision 7x7/s2 or "
+                        "space-to-depth 4x4/s1 (docs/ROOFLINE.md)")
     p.add_argument("--grad-accum", type=int, default=c.grad_accum,
                    help="micro-batches per optimizer step (default 1)")
     p.add_argument("--schedule", type=str, default=c.schedule,

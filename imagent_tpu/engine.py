@@ -275,6 +275,12 @@ def run(cfg: Config, stop_check=None) -> dict:
         raise ValueError("--fsdp is its own execution path (XLA SPMD "
                          "partitioner); it does not combine with the "
                          "shard_map strategies, --zero1, or --grad-accum")
+    if cfg.stem != "v1":
+        if cfg.arch.startswith("vit"):
+            raise ValueError("--stem applies to the ResNet family only")
+        if cfg.init_from_torch:
+            raise ValueError("--init-from-torch requires --stem v1 (the "
+                             "s2d stem has a different conv1 shape)")
 
     train_loader, val_loader = make_loaders(
         cfg, jax.process_index(), jax.process_count(), global_batch,
@@ -321,7 +327,9 @@ def run(cfg: Config, stop_check=None) -> dict:
                              attn_impl=cfg.attn, remat=cfg.remat)
         init_model = model
     else:
-        model = create_model(cfg.arch, cfg.num_classes, cfg.bf16, remat=cfg.remat)
+        kw = {} if cfg.arch.startswith("vit") else {"stem": cfg.stem}
+        model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                             remat=cfg.remat, **kw)
         init_model = model
     if cfg.zero1 and cfg.optimizer != "sgd":
         raise ValueError("--zero1 implements the sharded SGD update; use "

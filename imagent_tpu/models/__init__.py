@@ -44,8 +44,10 @@ def create_model(arch: str, num_classes: int = 1000, bf16: bool = False,
         return vit.create_vit(arch, num_classes=num_classes, dtype=dtype,
                               **overrides)
     remat = overrides.pop("remat", False)  # shared flag, both families
+    stem = overrides.pop("stem", "v1")
     if overrides:
         raise ValueError(f"overrides {sorted(overrides)} only apply to ViT")
     if arch not in _REGISTRY:
         raise ValueError(f"unknown arch {arch!r}; one of {available_models()}")
-    return _REGISTRY[arch](num_classes=num_classes, dtype=dtype, remat=remat)
+    return _REGISTRY[arch](num_classes=num_classes, dtype=dtype, remat=remat,
+                           stem=stem)

@@ -115,6 +115,16 @@ class ResNet(nn.Module):
     # trading ~33% more FLOPs for O(depth) less activation HBM — the
     # standard lever for fitting larger batches/images per chip.
     remat: bool = False
+    # Stem variant. "v1" is the torchvision-exact 7x7/s2 conv (3 input
+    # channels — wastes MXU lanes: 3 of 8 sublanes used). "s2d" is the
+    # MLPerf-style space-to-depth rewrite: pixels are rearranged
+    # (B,H,W,3)->(B,H/2,W/2,12) on the host-free reshape path and the
+    # stem becomes a 4x4/s1 conv over 12 channels — the same functional
+    # family (every 7x7/s2 stem has an exact 4x4-on-s2d equivalent via
+    # weight rearrangement), but much better tiled onto the MXU.
+    # Param count differs (4*4*12*64 vs 7*7*3*64), so the torch
+    # checkpoint-import path requires stem="v1" (the default).
+    stem: str = "v1"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -124,8 +134,23 @@ class ResNet(nn.Module):
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                        axis_name=None)  # per-replica stats = DDP semantics
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=_sym_pad(7),
-                 name="conv1")(x)
+        if self.stem not in ("v1", "s2d"):
+            raise ValueError(f"unknown stem {self.stem!r}; 'v1' or 's2d'")
+        if self.stem == "s2d":
+            b, h, w, c = x.shape
+            if h % 2 or w % 2:
+                raise ValueError(
+                    f"stem='s2d' needs even H/W (space-to-depth "
+                    f"rearrange), got {h}x{w}")
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2,
+                                                      4 * c)
+            # pad (2,1): exact receptive-field match of 7x7/s2 pad 3
+            x = conv(self.num_filters, (4, 4), (1, 1),
+                     padding=((2, 1), (2, 1)), name="conv1")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), padding=_sym_pad(7),
+                     name="conv1")(x)
         x = norm(name="bn1")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

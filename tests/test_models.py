@@ -67,3 +67,27 @@ def test_vit_forward_shape():
     logits = model.apply(variables, x, train=False)
     assert logits.shape == (2, 10)
     assert logits.dtype == jnp.float32
+
+
+def test_s2d_stem_equivalent_family():
+    """The space-to-depth stem (docs/ROOFLINE.md "levers") is the
+    MLPerf-style exact rewrite of the 7x7/s2 stem: same output shape,
+    4x4x12x64 conv1 kernel, and the train step still learns."""
+    model = create_model("resnet18", num_classes=10, stem="s2d")
+    x = jax.random.normal(jax.random.key(1), (2, 64, 64, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    assert variables["params"]["conv1"]["kernel"].shape == (4, 4, 12, 64)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    # same spatial plan as v1: conv1 output is H/2 = 32
+    _, inter = model.apply(variables, x, train=False,
+                           capture_intermediates=True)
+    conv1_out = inter["intermediates"]["conv1"]["__call__"][0]
+    assert conv1_out.shape == (2, 32, 32, 64)
+    # the even-H/W requirement is an explicit error, not a reshape crash
+    with pytest.raises(ValueError, match="even H/W"):
+        model.init(jax.random.key(0),
+                   jax.numpy.zeros((1, 63, 63, 3)), train=False)
+    with pytest.raises(ValueError, match="unknown stem"):
+        create_model("resnet18", num_classes=10, stem="S2D").init(
+            jax.random.key(0), x, train=False)
