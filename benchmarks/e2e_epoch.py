@@ -1,0 +1,159 @@
+"""Honest end-to-end epoch benchmark: decode → prefetch → step walltime.
+
+The reference's 152.8 img/s/GPU is derived from whole-epoch walltime
+over the dataset size (`imagent_sgd.out:278,14`) — it *includes* loader
+stalls. bench.py's synthetic number excludes the input pipeline; this
+benchmark measures the same quantity the reference reported: a full
+training epoch through the production path (JPEG files on disk → native
+C++ decode+augment → host prefetch queue → H2D staging → jitted SPMD
+step), timed wall-to-wall.
+
+The dataset is a generated deterministic texture ImageFolder
+(imagent_tpu/data/texturegen.py), cached across runs. Output is one
+JSON line with both the end-to-end and the compute-only rate for the
+same config, plus the host core count — on a 1-core sandbox host the
+pipeline, not the chip, is the bottleneck; a TPU-VM host (100+ vCPU)
+scales the decode stage linearly with --workers.
+
+    python benchmarks/e2e_epoch.py                    # r18@448 defaults
+    python benchmarks/e2e_epoch.py --image-size 224 \
+        --arch resnet50 --disk-size 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--arch", default="resnet18")
+    p.add_argument("--image-size", type=int, default=448)
+    p.add_argument("--batch-size", type=int, default=128, help="per chip")
+    p.add_argument("--classes", type=int, default=8)
+    p.add_argument("--per-class", type=int, default=256,
+                   help="train images per class (dataset size)")
+    p.add_argument("--disk-size", type=int, default=512,
+                   help="generated JPEG side length")
+    p.add_argument("--workers", type=int, default=0,
+                   help="decode threads (0 = all host cores)")
+    p.add_argument("--data-root", default="/tmp/imagent_e2e_textures")
+    a = p.parse_args()
+
+    import jax
+
+    from imagent_tpu.config import Config
+    from imagent_tpu.data.pipeline import make_loaders
+    from imagent_tpu.data.texturegen import generate_imagefolder
+    from imagent_tpu.engine import train_one_epoch
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step,
+        replicate_state,
+    )
+    from imagent_tpu.cluster import make_mesh
+
+    t0 = time.time()
+    generate_imagefolder(a.data_root, n_classes=a.classes,
+                         train_per_class=a.per_class, val_per_class=8,
+                         img=a.disk_size)
+    gen_s = time.time() - t0
+
+    n_chips = len(jax.devices())
+    workers = a.workers or os.cpu_count() or 1
+    cfg = Config(arch=a.arch, image_size=a.image_size,
+                 num_classes=a.classes, batch_size=a.batch_size,
+                 dataset="imagefolder", data_root=a.data_root,
+                 augment=True, workers=workers, bf16=True, input_bf16=True,
+                 log_every=0, seed=0, epochs=2)
+    global_batch = cfg.batch_size * n_chips
+    mesh = make_mesh(model_parallel=1)
+    from imagent_tpu.models import create_model
+    model = create_model(cfg.arch, cfg.num_classes, bf16=True)
+    opt = make_optimizer()
+    state = replicate_state(
+        create_train_state(model, jax.random.key(0), cfg.image_size, opt,
+                           batch_size=2), mesh)
+    step = make_train_step(model, opt, mesh)
+    train_loader, _ = make_loaders(cfg, jax.process_index(),
+                                   jax.process_count(), global_batch)
+
+    # Warmup epoch 0: compiles the step and fills the decode caches.
+    state, _, warm_s, _ = train_one_epoch(
+        cfg, mesh, step, state, train_loader, 0, 0.1, is_master=True)
+
+    # Timed epoch 1: the reference's quantity — whole-epoch walltime.
+    n_imgs = train_loader.steps_per_epoch * global_batch
+    state, metrics, epoch_s, _ = train_one_epoch(
+        cfg, mesh, step, state, train_loader, 1, 0.1, is_master=True)
+    e2e_img_s = n_imgs / epoch_s
+
+    # Per-stage rates for the same config, so the JSON names the
+    # binding stage on THIS host rather than hand-waving:
+    #   decode: native path, one local batch, current worker count
+    #   h2d:    shard_batch staging of one local batch
+    #   compute: jitted-step throughput (bench.measure, device-resident)
+    import glob
+
+    from imagent_tpu import native
+    from imagent_tpu.train import shard_batch
+    from bench import measure
+
+    local = cfg.batch_size
+    paths = sorted(glob.glob(os.path.join(
+        a.data_root, "train", "*", "*.jpg")))[:local]
+    t0 = time.time()
+    imgs, _ = native.decode_resize_batch(
+        paths, cfg.image_size, cfg.mean, cfg.std, n_threads=workers,
+        aug_seeds=np.arange(local, dtype=np.uint64))
+    decode_img_s = local / (time.time() - t0)
+    import ml_dtypes
+    host_batch = imgs.astype(ml_dtypes.bfloat16)
+    labels = np.zeros((local,), np.int32)
+    def _sync(gi, gl):
+        # Hard fetch of a reduction over BOTH arrays: np.asarray is the
+        # only reliable sync on this platform (block_until_ready returns
+        # early), and depending on gi guarantees the big image transfer
+        # actually landed before the timer stops.
+        np.asarray(jax.numpy.max(gi).astype(jax.numpy.float32))
+        np.asarray(jax.numpy.max(gl))
+
+    gi, gl = shard_batch(mesh, host_batch, labels)
+    _sync(gi, gl)
+    t0 = time.time()
+    gi, gl = shard_batch(mesh, host_batch, labels)
+    _sync(gi, gl)
+    h2d_s = time.time() - t0
+    h2d_img_s = local / h2d_s
+    compute = measure(a.arch, a.image_size, a.batch_size, iters=5,
+                      windows=2)
+    stages = {"decode": decode_img_s, "h2d": h2d_img_s,
+              "compute": compute["value"]}
+
+    print(json.dumps({
+        "metric": f"{a.arch}_{a.image_size}_e2e_epoch_throughput",
+        "value": round(e2e_img_s / n_chips, 2),
+        "unit": "img/s/chip",
+        "epoch_seconds": round(epoch_s, 2),
+        "epoch_images": n_imgs,
+        "stage_img_s": {k: round(v, 1) for k, v in stages.items()},
+        "bottleneck": min(stages, key=stages.get),
+        "h2d_mb_s": round(host_batch.nbytes / 1e6 / h2d_s, 1),
+        "host_cores": os.cpu_count(),
+        "decode_workers": workers,
+        "warmup_epoch_seconds": round(warm_s, 2),
+        "dataset_gen_seconds": round(gen_s, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

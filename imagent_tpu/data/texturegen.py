@@ -1,0 +1,78 @@
+"""Deterministic on-disk JPEG ImageFolder generator.
+
+Produces a parameterized texture dataset (N hue-family classes with
+random luminance gratings) laid out as ``root/{train,val}/class_k/*.jpg``
+— the same directory contract as torchvision's ImageFolder (the
+reference's ``datasets.ImageNet`` reduces to it, ``imagenet.py:287``).
+
+Used by the real-data convergence test (tests/test_real_data.py) and
+the end-to-end epoch benchmark (benchmarks/e2e_epoch.py): hue is
+crop-invariant (survives RandomResizedCrop at any scale),
+decode-sensitive (channel swaps / normalization bugs collapse the
+classes), and robust to JPEG chroma quantization at q90. Generation is
+a pure function of (class, index), so the same parameters always yield
+byte-identical datasets.
+"""
+
+from __future__ import annotations
+
+import colorsys
+import json
+import os
+
+import numpy as np
+
+
+def texture(cls: int, idx: int, n_classes: int, img: int) -> np.ndarray:
+    """Deterministic RGB texture for (class, index)."""
+    rng = np.random.default_rng(cls * 100_003 + idx)
+    yy, xx = np.mgrid[0:img, 0:img].astype(np.float32)
+    phase = rng.uniform(0, 2 * np.pi)
+    wavelength = rng.uniform(10, 18) * img / 64.0
+    theta = rng.uniform(0, np.pi)
+    base = np.asarray(colorsys.hsv_to_rgb(
+        (cls / n_classes + rng.uniform(-0.03, 0.03)) % 1.0, 0.85, 0.8),
+        np.float32)
+    wave = np.sin(2 * np.pi * (xx * np.cos(theta) + yy * np.sin(theta))
+                  / wavelength + phase)
+    lum = 0.75 + 0.25 * wave
+    out = base[None, None, :] * lum[:, :, None]
+    out = out + rng.normal(0, 0.02, out.shape)
+    return (out.clip(0, 1) * 255).astype(np.uint8)
+
+
+def generate_imagefolder(root: str, n_classes: int = 8,
+                         train_per_class: int = 40, val_per_class: int = 8,
+                         img: int = 64, quality: int = 90) -> str:
+    """Write the dataset under ``root`` (idempotent: a manifest records
+    the parameters; matching manifest ⇒ reuse, mismatch ⇒ regenerate)."""
+    from PIL import Image
+
+    manifest = dict(n_classes=n_classes, train_per_class=train_per_class,
+                    val_per_class=val_per_class, img=img, quality=quality,
+                    version=1)
+    mpath = os.path.join(root, "manifest.json")
+    if os.path.exists(mpath):
+        try:
+            if json.load(open(mpath)) == manifest:
+                return root
+        except (json.JSONDecodeError, OSError):
+            pass
+    # Parameter mismatch: clear stale splits so a shrunk class/image
+    # count can't leave extra files for the ImageFolder scan to find.
+    import shutil
+    for split in ("train", "val"):
+        shutil.rmtree(os.path.join(root, split), ignore_errors=True)
+    if os.path.exists(mpath):
+        os.remove(mpath)
+    for split, per_class, base in (("train", train_per_class, 0),
+                                   ("val", val_per_class, 10_000_000)):
+        for cls in range(n_classes):
+            d = os.path.join(root, split, f"class_{cls}")
+            os.makedirs(d, exist_ok=True)
+            for i in range(per_class):
+                Image.fromarray(texture(cls, base + i, n_classes, img)).save(
+                    os.path.join(d, f"{i:05d}.jpg"), quality=quality)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    return root

@@ -12,58 +12,21 @@ The decode itself is parity-tested in test_native_io.py; here the
 assertion is that the *whole pipeline* trains.
 """
 
-import numpy as np
 import pytest
-from PIL import Image
 
 from imagent_tpu.config import Config
+from imagent_tpu.data.texturegen import generate_imagefolder
 from imagent_tpu.engine import run
 from imagent_tpu.native import loader as native_loader
 
 N_CLASSES = 8
-TRAIN_PER_CLASS = 40
-VAL_PER_CLASS = 8
-IMG = 64  # on-disk size; training resizes/crops to cfg.image_size
-
-
-def _hsv_to_rgb(h, s, v):
-    import colorsys
-    return colorsys.hsv_to_rgb(h % 1.0, s, v)
-
-
-def _texture(cls: int, idx: int) -> np.ndarray:
-    """Deterministic 64x64 RGB texture: 8 hue families with a random
-    luminance grating. Hue is crop-invariant (survives
-    RandomResizedCrop at any scale) and decode-sensitive (a channel
-    swap or normalization bug collapses the classes), and survives
-    JPEG chroma quantization at q90."""
-    rng = np.random.default_rng(cls * 100_003 + idx)
-    yy, xx = np.mgrid[0:IMG, 0:IMG].astype(np.float32)
-    phase = rng.uniform(0, 2 * np.pi)
-    wavelength = rng.uniform(10, 18)
-    theta = rng.uniform(0, np.pi)
-    base = np.asarray(_hsv_to_rgb(cls / N_CLASSES
-                                  + rng.uniform(-0.03, 0.03), 0.85, 0.8),
-                      np.float32)
-    wave = np.sin(2 * np.pi * (xx * np.cos(theta) + yy * np.sin(theta))
-                  / wavelength + phase)
-    lum = 0.75 + 0.25 * wave
-    img = base[None, None, :] * lum[:, :, None]
-    img = img + rng.normal(0, 0.02, img.shape)
-    return (img.clip(0, 1) * 255).astype(np.uint8)
 
 
 @pytest.fixture(scope="module")
 def texture_root(tmp_path_factory):
     root = tmp_path_factory.mktemp("textures")
-    for split, per_class, base in (("train", TRAIN_PER_CLASS, 0),
-                                   ("val", VAL_PER_CLASS, 10_000)):
-        for cls in range(N_CLASSES):
-            d = root / split / f"class_{cls}"
-            d.mkdir(parents=True)
-            for i in range(per_class):
-                Image.fromarray(_texture(cls, base + i)).save(
-                    str(d / f"{i:03d}.jpg"), quality=90)
+    generate_imagefolder(str(root), n_classes=N_CLASSES,
+                         train_per_class=40, val_per_class=8, img=64)
     return root
 
 
