@@ -96,10 +96,11 @@ def main() -> int:
         cfg, mesh, step, state, train_loader, 1, 0.1, is_master=True)
     e2e_img_s = n_imgs / epoch_s
 
-    # Per-stage rates for the same config, so the JSON names the
+    # Per-stage rates for the same config, all in img/s/chip (the unit
+    # a multi-chip step actually needs per chip), so the JSON names the
     # binding stage on THIS host rather than hand-waving:
-    #   decode: native path, one local batch, current worker count
-    #   h2d:    shard_batch staging of one local batch
+    #   decode: host-wide native rate / n_chips
+    #   h2d:    shard_batch staging of a GLOBAL batch / n_chips
     #   compute: jitted-step throughput (bench.measure, device-resident)
     import glob
 
@@ -114,10 +115,11 @@ def main() -> int:
     imgs, _ = native.decode_resize_batch(
         paths, cfg.image_size, cfg.mean, cfg.std, n_threads=workers,
         aug_seeds=np.arange(local, dtype=np.uint64))
-    decode_img_s = local / (time.time() - t0)
+    decode_img_s = local / (time.time() - t0) / n_chips
     import ml_dtypes
-    host_batch = imgs.astype(ml_dtypes.bfloat16)
-    labels = np.zeros((local,), np.int32)
+    host_batch = np.tile(imgs.astype(ml_dtypes.bfloat16),
+                         (n_chips, 1, 1, 1))  # one GLOBAL batch
+    labels = np.zeros((global_batch,), np.int32)
     def _sync(gi, gl):
         # Hard fetch of a reduction over BOTH arrays: np.asarray is the
         # only reliable sync on this platform (block_until_ready returns
@@ -132,7 +134,7 @@ def main() -> int:
     gi, gl = shard_batch(mesh, host_batch, labels)
     _sync(gi, gl)
     h2d_s = time.time() - t0
-    h2d_img_s = local / h2d_s
+    h2d_img_s = global_batch / h2d_s / n_chips
     compute = measure(a.arch, a.image_size, a.batch_size, iters=5,
                       windows=2)
     stages = {"decode": decode_img_s, "h2d": h2d_img_s,

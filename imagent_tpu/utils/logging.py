@@ -17,11 +17,10 @@ class TrainLogger:
         self.is_master = is_master
         self.writer = None
         if is_master and tensorboard:
-            try:
-                from torch.utils.tensorboard import SummaryWriter
-                self.writer = SummaryWriter(log_dir)
-            except ImportError:
-                self.writer = None
+            # Pure-Python event writer (utils/tb_writer.py) — works on a
+            # torch-less TPU VM; same file format TensorBoard reads.
+            from imagent_tpu.utils.tb_writer import SummaryWriter
+            self.writer = SummaryWriter(log_dir)
 
     def epoch_summary(self, epoch: int, lr: float, train: dict,
                       val: dict | None, train_time: float,
