@@ -29,6 +29,11 @@ from imagent_tpu.config import Config
 from imagent_tpu.data.pipeline import (
     PAD_ROW, Batch, iter_batch_rows, pad_batch, shard_indices,
 )
+# Pure-Python module (no .so load at import): shared crop-parameter
+# derivation so both decode paths use identical fp32 constants.
+from imagent_tpu.native.loader import aug_params7
+
+_DEFAULT_P7 = aug_params7()
 
 _EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
 
@@ -58,38 +63,98 @@ def _init_worker(size: int, mean, std):
     _W["std"] = np.asarray(std, np.float32)
 
 
-def _sample_crop(w: int, h: int, rng: np.random.Generator):
-    """torchvision ``RandomResizedCrop.get_params`` (scale (0.08, 1),
-    ratio (3/4, 4/3)) + hflip(0.5) for the PIL fallback path. Same
-    algorithm as ``io_loader.cc::sample_crop`` (independent RNG stream —
-    both are valid augmentation draws)."""
-    area = w * h
+_U64 = (1 << 64) - 1
+
+
+def _splitmix64(state: list) -> int:
+    """Bit-exact port of ``io_loader.cc::splitmix64`` — the PIL fallback
+    consumes the SAME stream as the native decoder, so a (seed, epoch,
+    row) triple yields the same crop/flip on both paths."""
+    state[0] = (state[0] + 0x9E3779B97F4A7C15) & _U64
+    z = state[0]
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _U64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _U64
+    return z ^ (z >> 31)
+
+
+def _uniform01(state: list) -> np.float32:
+    # C: float(u64 >> 11) * 0x1.0p-53f — keep the fp32 rounding.
+    return np.float32(np.float32(_splitmix64(state) >> 11)
+                      * np.float32(2.0 ** -53))
+
+
+def _lround(x: np.float32) -> int:
+    # io_loader.cc::lround_shared — floor(x + 0.5f) on both sides.
+    return int(np.floor(np.float32(x + np.float32(0.5))))
+
+
+_EXP_COEFFS = tuple(np.float32(c) for c in (
+    1.5403530393381608e-4, 1.3333558146428443e-3, 9.618129107628477e-3,
+    5.550410866482158e-2, 2.402265069591007e-1, 6.9314718056e-1, 1.0))
+_LOG2E = np.float32(1.4426950408889634)
+
+
+def _exp_shared(x: np.float32) -> np.float32:
+    """Operation-for-operation mirror of ``io_loader.cc::exp_shared``:
+    degree-6 Taylor of 2^f + bit-assembled exponent, basic fp32 ops
+    only — numpy's np.exp and libm's expf differ by 1 ULP on ~38% of
+    inputs, which crosses lround boundaries ~1.8e-5/sample, so neither
+    may participate in the shared augmentation stream."""
+    t = np.float32(x * _LOG2E)
+    fn = np.float32(np.floor(t))
+    f = np.float32(t - fn)
+    p = _EXP_COEFFS[0]
+    for c in _EXP_COEFFS[1:]:
+        p = np.float32(np.float32(p * f) + c)
+    n = int(fn)
+    scale = np.array((n + 127) << 23, np.uint32).view(np.float32)[()]
+    return np.float32(p * scale)
+
+
+def _sample_crop(w: int, h: int, seed: int, aug_params=None):
+    """torchvision ``RandomResizedCrop.get_params`` (default scale
+    (0.08, 1), ratio (3/4, 4/3)) + hflip(0.5): bit-exact port of
+    ``io_loader.cc::sample_crop`` including its fp32 arithmetic, so both
+    decode paths draw identical augmentations from one seed (parity:
+    tests/test_native_io.py). ``aug_params`` is the same 5-tuple the
+    native API takes."""
+
+    p7 = aug_params7(aug_params) if aug_params is not None else _DEFAULT_P7
+    scale_min, scale_max, ratio_min, ratio_max, hflip, log_rmin, log_rmax = p7
+    f32 = np.float32
+    s = [seed & _U64]
+    area = f32(f32(w) * f32(h))
     for _ in range(10):
-        target_area = area * rng.uniform(0.08, 1.0)
-        ar = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
-        cw = int(round(np.sqrt(target_area * ar)))
-        ch = int(round(np.sqrt(target_area / ar)))
+        target_area = f32(area * f32(scale_min + f32(_uniform01(s)
+                                     * f32(scale_max - scale_min))))
+        ar = _exp_shared(f32(log_rmin + f32(_uniform01(s)
+                                            * f32(log_rmax - log_rmin))))
+        cw = _lround(np.sqrt(f32(target_area * ar), dtype=np.float32))
+        ch = _lround(np.sqrt(f32(target_area / ar), dtype=np.float32))
         if 0 < cw <= w and 0 < ch <= h:
-            x = int(rng.integers(0, w - cw + 1))
-            y = int(rng.integers(0, h - ch + 1))
-            return x, y, cw, ch, bool(rng.random() < 0.5)
-    in_ratio = w / h
-    if in_ratio < 3 / 4:
-        cw, ch = w, int(round(w / (3 / 4)))
-    elif in_ratio > 4 / 3:
-        cw, ch = int(round(h * (4 / 3))), h
+            x = _splitmix64(s) % (w - cw + 1)
+            y = _splitmix64(s) % (h - ch + 1)
+            return int(x), int(y), cw, ch, bool(_uniform01(s) < hflip)
+    in_ratio = f32(f32(w) / f32(h))
+    if in_ratio < ratio_min:
+        cw, ch = w, _lround(f32(f32(w) / ratio_min))
+    elif in_ratio > ratio_max:
+        cw, ch = _lround(f32(f32(h) * ratio_max)), h
     else:
         cw, ch = w, h
-    return (w - cw) // 2, (h - ch) // 2, cw, ch, bool(rng.random() < 0.5)
+    return (w - cw) // 2, (h - ch) // 2, cw, ch, bool(_uniform01(s) < hflip)
 
 
-def _decode_one(path: str, aug_seed: int | None = None) -> np.ndarray:
+def _decode_one(path: str, aug_seed: int | None = None,
+                aug_params=None) -> np.ndarray:
+    """PIL decode path. ``aug_params`` must match whatever the native
+    call used so a rescue re-decode draws the identical crop."""
     size = _W["size"]
     with Image.open(path) as im:
         im = im.convert("RGB")
         if aug_seed is not None:
-            x, y, cw, ch, flip = _sample_crop(
-                *im.size, np.random.default_rng(aug_seed))
+            x, y, cw, ch, flip = _sample_crop(*im.size, aug_seed,
+                                              aug_params)
             im = im.resize((size, size), Image.BILINEAR,
                            box=(x, y, x + cw, y + ch))
             if flip:
@@ -177,7 +242,11 @@ class ImageFolderLoader:
     def _aug_seeds(self, rows: np.ndarray, epoch: int) -> np.ndarray | None:
         """Per-sample uint64 seed, a pure function of (seed, epoch, dataset
         row) — augmentation is reproducible and never repeats across
-        epochs (the ``set_epoch`` idea applied to the crop RNG)."""
+        epochs (the ``set_epoch`` idea applied to the crop RNG). Both
+        decode paths consume this seed through the SAME splitmix64
+        stream (``_sample_crop`` == ``io_loader.cc::sample_crop``), so
+        the training data is identical whether or not the native
+        decoder is available."""
         if not (self.train and self.cfg.augment):
             return None
         return (rows.astype(np.uint64)

@@ -48,7 +48,38 @@ struct Crop {
 // from Python: scale (0.08, 1.0), ratio (3/4, 4/3), hflip_prob 0.5).
 struct Aug {
   float scale_min, scale_max, ratio_min, ratio_max, hflip_prob;
+  // log(ratio_min/max), precomputed on the Python side: no libm call
+  // participates in the sampled stream, so the PIL fallback's Python
+  // port stays bit-exact (libm expf/logf differ from numpy by 1 ULP).
+  float log_rmin, log_rmax;
 };
+
+// Shared exp: degree-6 Taylor of 2^f with bit-assembled exponent, basic
+// fp32 ops only (no fma, no libm) — mirrored operation-for-operation in
+// data/imagefolder.py::_exp_shared so both decode paths round
+// identically on every platform.
+float exp_shared(float x) {
+  const float t = x * 1.4426950408889634f;  // log2(e)
+  const float fn = std::floor(t);
+  const float f = t - fn;
+  float p = 1.5403530393381608e-4f;
+  p = p * f + 1.3333558146428443e-3f;
+  p = p * f + 9.618129107628477e-3f;
+  p = p * f + 5.550410866482158e-2f;
+  p = p * f + 2.402265069591007e-1f;
+  p = p * f + 6.9314718056e-1f;
+  p = p * f + 1.0f;
+  const int n = static_cast<int>(fn);
+  uint32_t bits = static_cast<uint32_t>(n + 127) << 23;
+  float scale;
+  std::memcpy(&scale, &bits, sizeof(scale));
+  return p * scale;
+}
+
+// C lround rounds half away from zero; floor(x + 0.5) is cheaper to
+// mirror exactly in Python and identical for the non-negative values
+// sampled here.
+int lround_shared(float x) { return static_cast<int>(std::floor(x + 0.5f)); }
 
 // splitmix64: deterministic per-(seed, epoch, sample) stream, so an epoch's
 // augmentation is reproducible across runs and across the native/PIL paths'
@@ -70,16 +101,16 @@ Crop sample_crop(int w, int h, const Aug& aug, uint64_t seed) {
   uint64_t s = seed;
   Crop c;
   const float area = static_cast<float>(w) * h;
-  const float log_rmin = std::log(aug.ratio_min);
-  const float log_rmax = std::log(aug.ratio_max);
+  const float log_rmin = aug.log_rmin;
+  const float log_rmax = aug.log_rmax;
   for (int attempt = 0; attempt < 10; ++attempt) {
     const float target_area =
         area * (aug.scale_min +
                 uniform01(&s) * (aug.scale_max - aug.scale_min));
     const float ar =
-        std::exp(log_rmin + uniform01(&s) * (log_rmax - log_rmin));
-    const int cw = static_cast<int>(std::lround(std::sqrt(target_area * ar)));
-    const int ch_ = static_cast<int>(std::lround(std::sqrt(target_area / ar)));
+        exp_shared(log_rmin + uniform01(&s) * (log_rmax - log_rmin));
+    const int cw = lround_shared(std::sqrt(target_area * ar));
+    const int ch_ = lround_shared(std::sqrt(target_area / ar));
     if (cw > 0 && ch_ > 0 && cw <= w && ch_ <= h) {
       c.x = static_cast<float>(splitmix64(&s) % (w - cw + 1));
       c.y = static_cast<float>(splitmix64(&s) % (h - ch_ + 1));
@@ -94,10 +125,10 @@ Crop sample_crop(int w, int h, const Aug& aug, uint64_t seed) {
   int cw, ch_;
   if (in_ratio < aug.ratio_min) {
     cw = w;
-    ch_ = static_cast<int>(std::lround(w / aug.ratio_min));
+    ch_ = lround_shared(w / aug.ratio_min);
   } else if (in_ratio > aug.ratio_max) {
     ch_ = h;
-    cw = static_cast<int>(std::lround(h * aug.ratio_max));
+    cw = lround_shared(h * aug.ratio_max);
   } else {
     cw = w;
     ch_ = h;
@@ -454,8 +485,8 @@ extern "C" {
 
 // Returns the number of images that FAILED to decode (ok[i] == 0 for those;
 // their output rows are left untouched for the Python fallback to fill).
-// `aug_params` (5 floats: scale_min, scale_max, ratio_min, ratio_max,
-// hflip_prob) and `aug_seeds` (one uint64 per image) are both NULL for the
+// `aug_params` (7 floats: scale_min, scale_max, ratio_min, ratio_max,
+// hflip_prob, log_ratio_min, log_ratio_max — logs precomputed caller-side) and `aug_seeds` (one uint64 per image) are both NULL for the
 // plain resize path, both non-NULL for RandomResizedCrop + flip.
 int64_t il_decode_resize_batch(const char* const* paths, int64_t n,
                                int out_size, const float* mean,
@@ -468,7 +499,7 @@ int64_t il_decode_resize_batch(const char* const* paths, int64_t n,
   const Aug* aug = nullptr;
   if (aug_params && aug_seeds) {
     aug_val = Aug{aug_params[0], aug_params[1], aug_params[2], aug_params[3],
-                  aug_params[4]};
+                  aug_params[4], aug_params[5], aug_params[6]};
     aug = &aug_val;
   }
   const size_t row = static_cast<size_t>(out_size) * out_size * 3;
@@ -498,6 +529,22 @@ int64_t il_decode_resize_batch(const char* const* paths, int64_t n,
   return failed.load();
 }
 
-int il_version() { return 2; }
+// Expose the crop sampler for cross-path parity testing: the PIL
+// fallback (data/imagefolder.py::_sample_crop) ports this bit-exactly
+// so a (seed, epoch, row) triple augments identically on both paths.
+// `out5` = {x, y, w, h, flip}.
+void il_sample_crop(int w, int h, const float* aug_params, uint64_t seed,
+                    float* out5) {
+  const Aug aug{aug_params[0], aug_params[1], aug_params[2], aug_params[3],
+                aug_params[4], aug_params[5], aug_params[6]};
+  const Crop c = sample_crop(w, h, aug, seed);
+  out5[0] = c.x;
+  out5[1] = c.y;
+  out5[2] = c.w;
+  out5[3] = c.h;
+  out5[4] = c.flip ? 1.0f : 0.0f;
+}
+
+int il_version() { return 4; }
 
 }  // extern "C"

@@ -27,7 +27,7 @@ _lib: ctypes.CDLL | None = None
 _load_failed = False
 
 # Must match io_loader.cc::il_version(). Bump BOTH on any C-ABI change.
-_ABI_VERSION = 2
+_ABI_VERSION = 4
 
 
 def _abi_version(lib: ctypes.CDLL) -> int:
@@ -111,6 +111,18 @@ DEFAULT_AUG = (0.08, 1.0, 3.0 / 4.0, 4.0 / 3.0, 0.5)
 ratio_min, ratio_max, hflip_prob)."""
 
 
+def aug_params7(aug_params: tuple = DEFAULT_AUG) -> np.ndarray:
+    """The 7-float C-side parameter block: the 5 public params plus
+    fp32 log(ratio_min/max) precomputed HERE so no libm call enters the
+    sampled stream — the C sampler and the PIL fallback's Python port
+    (data/imagefolder.py::_sample_crop) then round identically."""
+    p = np.asarray(aug_params, np.float32)
+    if p.shape != (5,):
+        raise ValueError(f"aug_params must be 5 floats, got {aug_params!r}")
+    logs = np.log(p[2:4].astype(np.float64)).astype(np.float32)
+    return np.ascontiguousarray(np.concatenate([p, logs]))
+
+
 def decode_resize_batch(paths: list[str], size: int, mean, std,
                         n_threads: int = 0,
                         out: np.ndarray | None = None,
@@ -148,7 +160,7 @@ def decode_resize_batch(paths: list[str], size: int, mean, std,
         if len(aug_seeds) != n:
             raise ValueError(f"{len(aug_seeds)} seeds for {n} images")
         seeds_a = np.ascontiguousarray(aug_seeds, np.uint64)
-        params_a = np.ascontiguousarray(aug_params, np.float32)
+        params_a = aug_params7(aug_params)
         c_seeds = seeds_a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
         c_params = params_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
     else:
@@ -166,3 +178,22 @@ def decode_resize_batch(paths: list[str], size: int, mean, std,
     if not okb.all():
         out[~okb] = 0.0
     return out, okb
+
+
+def sample_crop(w: int, h: int, seed: int,
+                aug_params: tuple = DEFAULT_AUG) -> tuple:
+    """The C sampler's (x, y, cw, ch, flip) for one (size, seed) — the
+    ground truth the PIL fallback's Python port is parity-tested
+    against (tests/test_native_io.py)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native loader unavailable")
+    params = aug_params7(aug_params)
+    out = np.zeros((5,), np.float32)
+    lib.il_sample_crop(
+        ctypes.c_int(w), ctypes.c_int(h),
+        params.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(seed),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return (int(out[0]), int(out[1]), int(out[2]), int(out[3]),
+            bool(out[4] > 0.5))

@@ -196,3 +196,51 @@ def test_native_matches_python_fallback_pipeline(tmp_path):
     (bn,), (bp,) = list(nat.epoch(0)), list(pyl.epoch(0))
     np.testing.assert_array_equal(bn.labels, bp.labels)
     assert np.abs(bn.images - bp.images).max() < 0.02
+
+
+def test_crop_sampler_cross_path_parity():
+    """The PIL fallback's Python sampler must be bit-exact with the C
+    sampler for the same (w, h, seed) — one augmentation stream, both
+    paths (VERDICT r1 weak-6)."""
+    from imagent_tpu.data.imagefolder import _sample_crop
+    rng = np.random.default_rng(7)
+    checked_fallback = 0
+    # Seeds that exposed 1-ULP libm-vs-numpy expf divergence before the
+    # shared exp (io_loader.cc::exp_shared) replaced libm in the stream:
+    for seed in (6410582595784825213, 3393932964677808911,
+                 7861975621329669483):
+        assert _sample_crop(1000, 1000, seed) == \
+            native_loader.sample_crop(1000, 1000, seed)
+    for _ in range(500):
+        w = int(rng.integers(8, 1200))
+        h = int(rng.integers(8, 1200))
+        seed = int(rng.integers(0, 2 ** 63))
+        py = _sample_crop(w, h, seed)
+        c = native_loader.sample_crop(w, h, seed)
+        assert py == c, (w, h, seed, py, c)
+    # Extreme aspect ratios force the 10-attempt fallback branch; cover
+    # it explicitly on both paths.
+    for w, h in ((1000, 8), (8, 1000)):
+        for seed in range(50):
+            py = _sample_crop(w, h, seed)
+            c = native_loader.sample_crop(w, h, seed)
+            assert py == c, (w, h, seed, py, c)
+            checked_fallback += 1
+    assert checked_fallback == 100
+
+
+def test_augmented_decode_pixel_parity(tmp_path):
+    """Same (seed) -> same crop/flip -> near-identical pixels from the
+    native decoder and the PIL fallback (resamplers differ slightly)."""
+    from imagent_tpu.data.imagefolder import _decode_one, _init_worker
+    p = str(tmp_path / "a.jpg")
+    Image.fromarray(_smooth(300, 400)).save(p, quality=95)
+    size = 224
+    _init_worker(size, MEAN, STD)
+    seeds = np.asarray([3, 11, 12345, 999_999_937], np.uint64)
+    out, ok = native_loader.decode_resize_batch(
+        [p] * len(seeds), size, MEAN, STD, aug_seeds=seeds)
+    assert ok.all()
+    for i, seed in enumerate(seeds):
+        pil = _decode_one(p, int(seed))
+        assert np.abs(out[i] - pil).mean() < 0.02, int(seed)
