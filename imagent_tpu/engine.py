@@ -270,11 +270,10 @@ def run(cfg: Config, stop_check=None) -> dict:
     if cfg.zero1 and (use_sp or use_tp or use_pp or use_ep):
         raise ValueError("--zero1 currently supports the data-parallel "
                          "path only (parallel/zero.py)")
-    if cfg.fsdp and (use_sp or use_tp or use_pp or use_ep or cfg.zero1
-                     or cfg.grad_accum > 1):
+    if cfg.fsdp and (use_sp or use_tp or use_pp or use_ep or cfg.zero1):
         raise ValueError("--fsdp is its own execution path (XLA SPMD "
                          "partitioner); it does not combine with the "
-                         "shard_map strategies, --zero1, or --grad-accum")
+                         "shard_map strategies or --zero1")
     if cfg.stem != "v1":
         if cfg.arch.startswith("vit"):
             raise ValueError("--stem applies to the ResNet family only")
@@ -381,7 +380,8 @@ def run(cfg: Config, stop_check=None) -> dict:
         train_step = make_train_step_auto(
             model, optimizer, mesh, state_specs,
             label_smoothing=cfg.label_smoothing,
-            aux_loss_weight=cfg.moe_aux_weight)
+            aux_loss_weight=cfg.moe_aux_weight,
+            grad_accum=cfg.grad_accum)
         eval_step = make_eval_step_auto(model, mesh, state_specs)
     else:
         train_step = make_train_step(

@@ -46,8 +46,12 @@ def _build() -> bool:
     # builders must never let a rank CDLL a half-written .so. No
     # -march=native — the .so may be shared by heterogeneous hosts.
     tmp = f"{_LIB}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-shared",
-           "-o", tmp, _SRC, "-ljpeg", "-lpng", "-lwebp"]
+    # -ffp-contract=off: exp_shared/sample_crop must round exactly like
+    # the Python port (two roundings per p*f+c, never fused) — GCC's
+    # default contraction would emit fma on targets that have it and
+    # silently break cross-path augmentation parity.
+    cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-ffp-contract=off",
+           "-shared", "-o", tmp, _SRC, "-ljpeg", "-lpng", "-lwebp"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _LIB)

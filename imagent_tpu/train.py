@@ -193,6 +193,40 @@ def masked_eval_metrics(logits, labels, mask) -> jnp.ndarray:
     return jnp.stack([per_sample.sum(), c1, c5, mask.sum()])
 
 
+def _grads_and_metrics(grad_fn, params, batch_stats, images, labels):
+    """One batch: (grads, [loss_sum, top1, top5, n], new_batch_stats)."""
+    (_, (logits, per_sample, new_bs)), grads = grad_fn(
+        params, batch_stats, images, labels)
+    c1, c5 = topk_correct(logits, labels)
+    metrics = jnp.stack([per_sample.sum(), c1, c5,
+                         jnp.float32(labels.shape[0])])
+    return grads, metrics, new_bs
+
+
+def _scan_microbatches(grad_fn, params, batch_stats, images_k, labels_k,
+                       grad_accum):
+    """Shared accumulation scan over pre-sliced (K, B, ...) micro-batch
+    arrays — ONE implementation for both the explicit shard_map step and
+    the FSDP auto step, so the semantics can't drift. Gradients come
+    back as the mean of per-micro means (== mean over the full batch at
+    equal micro sizes, DDP's averaging); metrics as sums; BatchNorm
+    statistics chain through the scan."""
+
+    def micro(carry, xs):
+        bs, grads_acc, metrics_acc = carry
+        im, lb = xs
+        grads, m, bs = _grads_and_metrics(grad_fn, params, bs, im, lb)
+        grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+        return (bs, grads_acc, metrics_acc + m), None
+
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    (new_bs, grads_sum, metrics), _ = lax.scan(
+        micro, (batch_stats, zeros, jnp.zeros((4,), jnp.float32)),
+        (images_k, labels_k))
+    grads = jax.tree.map(lambda g: g / grad_accum, grads_sum)
+    return grads, metrics, new_bs
+
+
 def make_train_step(model, optimizer: optax.GradientTransformation,
                     mesh: Mesh, label_smoothing: float = 0.0,
                     seq_parallel: bool = False,
@@ -256,35 +290,12 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     def accumulate(params, batch_stats, images, labels):
         """(grads_mean, metrics_sum, new_batch_stats) over K micro-batches."""
         if grad_accum <= 1:
-            (_, (logits, per_sample, new_bs)), grads = grad_fn(
-                params, batch_stats, images, labels)
-            c1, c5 = topk_correct(logits, labels)
-            local = jnp.stack([per_sample.sum(), c1, c5,
-                               jnp.float32(labels.shape[0])])
-            return grads, local, new_bs
-
-        images = images.reshape(grad_accum, -1, *images.shape[1:])
-        labels = labels.reshape(grad_accum, -1)
-
-        def micro(carry, xs):
-            bs, grads_acc, metrics_acc = carry
-            im, lb = xs
-            (_, (logits, per_sample, bs)), grads = grad_fn(
-                params, bs, im, lb)
-            c1, c5 = topk_correct(logits, lb)
-            local = jnp.stack([per_sample.sum(), c1, c5,
-                               jnp.float32(lb.shape[0])])
-            grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
-            return (bs, grads_acc, metrics_acc + local), None
-
-        zeros = jax.tree.map(jnp.zeros_like, params)
-        (new_bs, grads_sum, metrics), _ = lax.scan(
-            micro, (batch_stats, zeros, jnp.zeros((4,), jnp.float32)),
-            (images, labels))
-        # mean of per-micro means == mean over the full device batch
-        # (equal micro sizes), keeping DDP's averaging semantics.
-        grads = jax.tree.map(lambda g: g / grad_accum, grads_sum)
-        return grads, metrics, new_bs
+            return _grads_and_metrics(grad_fn, params, batch_stats,
+                                      images, labels)
+        return _scan_microbatches(
+            grad_fn, params, batch_stats,
+            images.reshape(grad_accum, -1, *images.shape[1:]),
+            labels.reshape(grad_accum, -1), grad_accum)
 
     def per_device_step(state: TrainState, images, labels, lr):
         grads, local, new_bs = accumulate(
@@ -335,7 +346,8 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
 def make_train_step_auto(model, optimizer: optax.GradientTransformation,
                          mesh: Mesh, state_specs: TrainState,
                          label_smoothing: float = 0.0,
-                         aux_loss_weight: float = 0.01) -> Callable:
+                         aux_loss_weight: float = 0.01,
+                         grad_accum: int = 1) -> Callable:
     """FSDP train step via the XLA SPMD partitioner (``parallel/fsdp.py``).
 
     A PLAIN jitted function — no ``shard_map``, no axis names. Param and
@@ -344,27 +356,52 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
     per-layer all-gathers, the gradient reduce-scatters, and the metric
     reductions, overlapping them with compute.
 
+    ``grad_accum``: K sequential micro-batches inside the compiled step
+    (``lax.scan``), one optimizer update — the north-star geometry
+    (global-batch 2048 on few chips, ``imagenet.py:443``) under FSDP.
+    The global batch arrives as each device's K micro-shards
+    concatenated (the same loader layout the explicit path uses); the
+    reshape below regroups it per-microbatch along sharding boundaries,
+    so no resharding collective is inserted.
+
     Numerics note vs the explicit path: loss/grads are means over the
-    GLOBAL batch (identical to DDP's mean-of-means at equal shard
-    sizes), and BatchNorm statistics are computed over the global batch
-    (SyncBN semantics) rather than per-replica — the one deliberate
-    difference, since the partitioner sees a single logical batch.
+    GLOBAL (micro)batch (identical to DDP's mean-of-means at equal
+    shard sizes), and BatchNorm statistics are computed over the global
+    micro-batch (SyncBN semantics) rather than per-replica — the one
+    deliberate difference, since the partitioner sees a single logical
+    batch.
     """
     from imagent_tpu.parallel.fsdp import shardings_from_specs
 
     loss_fn = make_loss_fn(model, label_smoothing, aux_loss_weight)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    n_data = mesh.shape[DATA_AXIS]
+
+    def accumulate_auto(params, batch_stats, images, labels):
+        if grad_accum <= 1:
+            return _grads_and_metrics(grad_fn, params, batch_stats,
+                                      images, labels)
+        g = images.shape[0]
+        b_loc = g // (n_data * grad_accum)
+        # (n*K*b_loc, ...) -> (n, K, b_loc, ...) splits the sharded dim
+        # on its shard boundary (device i holds rows [i*K*b_loc, ...));
+        # the swap to (K, n, b_loc, ...) then merges back to per-micro
+        # global batches (K, n*b_loc, ...) still sharded over `data`.
+        im = images.reshape(n_data, grad_accum, b_loc, *images.shape[1:])
+        lb = labels.reshape(n_data, grad_accum, b_loc)
+        im = jnp.swapaxes(im, 0, 1).reshape(
+            grad_accum, n_data * b_loc, *images.shape[1:])
+        lb = jnp.swapaxes(lb, 0, 1).reshape(grad_accum, n_data * b_loc)
+        return _scan_microbatches(grad_fn, params, batch_stats, im, lb,
+                                  grad_accum)
 
     def step(state: TrainState, images, labels, lr):
-        (_, (logits, per_sample, new_bs)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params, state.batch_stats,
-                                   images, labels)
+        grads, metrics, new_bs = accumulate_auto(
+            state.params, state.batch_stats, images, labels)
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params)
         new_params = optax.apply_updates(
             state.params, jax.tree.map(lambda u: -lr * u, updates))
-        c1, c5 = topk_correct(logits, labels)
-        metrics = jnp.stack([per_sample.sum(), c1, c5,
-                             jnp.float32(labels.shape[0])])
         return state.replace(step=state.step + 1, params=new_params,
                              batch_stats=new_bs,
                              opt_state=new_opt_state), metrics

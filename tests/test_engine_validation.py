@@ -35,7 +35,6 @@ def _cfg(**kw):
     (dict(zero1=True, model_parallel=2, arch="vit_b16",
           tensor_parallel=True), "--zero1"),
     (dict(fsdp=True, zero1=True), "--fsdp"),
-    (dict(fsdp=True, grad_accum=2), "--fsdp"),
     (dict(zero1=True, optimizer="adamw"), "--zero1 implements"),
 ])
 def test_invalid_combinations_rejected(kw, match):

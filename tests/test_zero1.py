@@ -152,3 +152,56 @@ def test_zero1_e2e_smoke(tmp_path):
     cfg2 = cfg.replace(epochs=2, resume=True)
     result2 = run(cfg2)
     assert result2["best_epoch"] >= 0
+
+
+def test_zero1_grad_accum_matches_single_step():
+    """--zero1 + --grad-accum K (the north-star geometry on few chips):
+    K accumulated micro-batches through the sharded-momentum update must
+    equal one ZeRO-1 step over the same effective batch (BN-free model,
+    order-invariant gradient means)."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class _Plain(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Conv(8, (3, 3))(x)
+            x = nn.relu(x)
+            x = jnp.mean(x, axis=(1, 2))
+            return nn.Dense(4)(x)
+
+    K = 2
+    rng = np.random.default_rng(11)
+    images = rng.normal(size=(BATCH * K, SIZE, SIZE, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(BATCH * K,)).astype(np.int32)
+    mesh = make_mesh(model_parallel=1)
+    model = _Plain()
+    opt = make_optimizer()
+    host = jax.device_get(
+        create_train_state(model, jax.random.key(0), SIZE, opt))
+    lr = np.float32(0.05)
+    gi, gl = shard_batch(mesh, images, labels)
+
+    def make(grad_accum):
+        z = host.replace(
+            opt_state=zero_lib.init_opt_state(host.params, n_data=8))
+        specs = zero_lib.zero1_state_specs(z)
+        step = make_train_step(model, opt, mesh, state_specs=specs,
+                               zero1=True, grad_accum=grad_accum)
+        return place_state(z, mesh, specs), step
+
+    ref_state, ref_step = make(1)
+    ref_state, ref_metrics = ref_step(ref_state, gi, gl, lr)
+    acc_state, acc_step = make(K)
+    acc_state, acc_metrics = acc_step(acc_state, gi, gl, lr)
+
+    np.testing.assert_allclose(np.asarray(acc_metrics),
+                               np.asarray(ref_metrics), rtol=1e-4)
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(
+                jax.device_get(ref_state).params)[0],
+            jax.tree_util.tree_flatten_with_path(
+                jax.device_get(acc_state).params)[0]):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-4, atol=1e-6,
+            err_msg=jax.tree_util.keystr(path))
