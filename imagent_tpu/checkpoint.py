@@ -34,12 +34,19 @@ BEST = "best"
 LAST = "last"
 
 # Meta scalars stored inside the checkpoint tree (atomic with the state).
+# The topology triple (global_batch, process_count, seed) pins the
+# deterministic loader order a mid-epoch resume_step refers to — resume
+# on a different topology would skip the WRONG batches (some gradients
+# applied twice, others never); engine.run refuses/warns on mismatch.
 _META_FIELDS = (
     ("epoch", np.int64, -1),
     ("best_top1", np.float64, 0.0),
     ("best_top5", np.float64, 0.0),
     ("best_epoch", np.int64, -1),
     ("resume_step", np.int64, 0),
+    ("global_batch", np.int64, 0),
+    ("process_count", np.int64, 0),
+    ("seed", np.int64, -1),
 )
 
 _ckptr: ocp.StandardCheckpointer | None = None
@@ -107,21 +114,81 @@ def save(ckpt_dir: str, name: str, state: TrainState, meta: dict,
         _pending_meta = (ckpt_dir, name, meta)
 
 
+def _sidecar_meta(ckpt_dir: str, name: str) -> dict:
+    meta = {k: default for k, _, default in _META_FIELDS}
+    try:
+        with open(_meta_path(ckpt_dir, name)) as f:
+            meta.update(json.load(f))
+    except (OSError, json.JSONDecodeError):
+        pass  # sidecar lost: defaults resume from the best guess
+    return meta
+
+
 def restore(ckpt_dir: str, name: str,
             target: TrainState) -> tuple[TrainState, dict] | None:
     """Restore (state, meta) or None if absent. ``target`` supplies the
-    tree structure/shapes (an abstract or concrete TrainState)."""
+    tree structure/shapes (an abstract or concrete TrainState).
+
+    Layout-compatible across framework versions: the on-disk tree
+    metadata decides whether this is the current ``{state, meta}``
+    layout (restoring exactly the meta fields present — older
+    checkpoints simply lack newer fields, which default), or the
+    round-1 flat-TrainState layout (meta read from the JSON sidecar).
+    """
     wait_until_finished()  # a just-written checkpoint must be durable
     path = os.path.abspath(os.path.join(ckpt_dir, name))
     if not os.path.isdir(path):
         return None
     ckptr = ocp.StandardCheckpointer()
+    state_abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), target)
+
+    ondisk = None
+    try:
+        ondisk = ckptr.metadata(path).item_metadata.tree
+    except Exception:
+        pass  # metadata API unavailable/changed: probe by restoring
+
+    if isinstance(ondisk, dict) and "meta" in ondisk and "state" in ondisk:
+        present = set(ondisk["meta"])
+        abstract = {
+            "state": state_abstract,
+            "meta": {k: jax.ShapeDtypeStruct((), dtype)
+                     for k, dtype, _ in _META_FIELDS if k in present},
+        }
+        tree = ckptr.restore(path, abstract)
+        meta: dict[str, Any] = {k: default
+                                for k, _, default in _META_FIELDS}
+        meta.update({k: v.item() for k, v in tree["meta"].items()})
+        return tree["state"], meta
+
+    if isinstance(ondisk, dict):  # flat round-1 layout, definitively
+        state = ckptr.restore(path, state_abstract)
+        print(f"NOTE: restored legacy-layout checkpoint {path} "
+              "(pre-{state,meta} format); re-saving will migrate it",
+              flush=True)
+        return state, _sidecar_meta(ckpt_dir, name)
+
+    # Metadata unreadable: fall back to probing, current layout first.
     abstract = {
-        "state": jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), target),
+        "state": state_abstract,
         "meta": {k: jax.ShapeDtypeStruct((), dtype)
                  for k, dtype, _ in _META_FIELDS},
     }
-    tree = ckptr.restore(path, abstract)
-    meta: dict[str, Any] = {k: v.item() for k, v in tree["meta"].items()}
-    return tree["state"], meta
+    try:
+        tree = ckptr.restore(path, abstract)
+        return (tree["state"],
+                {k: v.item() for k, v in tree["meta"].items()})
+    except Exception as wrapped_err:
+        try:
+            state = ckptr.restore(path, state_abstract)
+        except Exception:
+            raise RuntimeError(
+                f"checkpoint at {path} matches neither the current "
+                "{state, meta} layout nor the legacy flat-TrainState "
+                "layout — arch/--num-classes/optimizer likely differ "
+                "from the run that wrote it") from wrapped_err
+        print(f"NOTE: restored legacy-layout checkpoint {path} "
+              "(pre-{state,meta} format); re-saving will migrate it",
+              flush=True)
+        return state, _sidecar_meta(ckpt_dir, name)

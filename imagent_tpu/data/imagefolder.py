@@ -18,8 +18,6 @@ memory), and the accelerator consumes via ``train.shard_batch``.
 from __future__ import annotations
 
 import os
-import queue
-import threading
 from typing import Iterator
 
 import numpy as np
@@ -32,6 +30,7 @@ from imagent_tpu.data.pipeline import (
 # Pure-Python module (no .so load at import): shared crop-parameter
 # derivation so both decode paths use identical fp32 constants.
 from imagent_tpu.native.loader import aug_params7
+from imagent_tpu.data.prefetch import iter_with_producer
 
 _DEFAULT_P7 = aug_params7()
 
@@ -284,27 +283,14 @@ class ImageFolderLoader:
             drop_remainder=self.train, global_batch=self.global_batch)
         chunks = list(iter_batch_rows(idx, self.local_rows))
 
-        q: queue.Queue = queue.Queue(maxsize=4)
+        def produce(put):
+            for rows in chunks:
+                if not put(self._decode_batch(rows, epoch)):
+                    return
 
-        def producer():
-            try:
-                for rows in chunks:
-                    q.put(self._decode_batch(rows, epoch))
-                q.put(None)
-            except BaseException as e:  # propagate, don't truncate the epoch
-                q.put(e)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is None:
-                break
-            if isinstance(item, BaseException):
-                t.join()
-                raise item
-            yield item
-        t.join()
+        # Shared cancellable producer/consumer protocol (prefetch.py):
+        # unwinds the decode thread deterministically on early exit.
+        yield from iter_with_producer(produce, maxsize=4)
 
     def close(self):
         if self._pool is not None:

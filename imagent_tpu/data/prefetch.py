@@ -9,15 +9,74 @@ current step — so the step dispatch never waits on the transfer.
 
 Depth 2 (double buffering) suffices: deeper queues only add device
 memory pressure (each in-flight batch holds its HBM buffers alive).
+
+``iter_with_producer`` is the one shared producer/consumer protocol —
+also used by the host-batch stage (``data/imagefolder.py``) — including
+the deterministic unwind an interrupted epoch needs (preemption break
+or step exception must not leave the producer blocked on a full queue,
+leaking the thread and its staged batches).
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Iterator
+from typing import Callable, Iterator
 
 from imagent_tpu.train import shard_batch
+
+
+def iter_with_producer(produce: Callable, maxsize: int) -> Iterator:
+    """Yield items that ``produce(put)`` stages from a daemon thread.
+
+    ``produce`` receives a ``put(item) -> bool`` callback and should
+    return when it yields False (consumer gone). Exceptions inside
+    ``produce`` propagate to the consumer. The ``finally`` block runs on
+    normal completion AND GeneratorExit (early consumer exit): it
+    releases the producer (stop flag + drain) and joins the thread, so
+    an interrupted epoch cannot leak the thread or the up-to-``maxsize``
+    staged items it holds alive.
+    """
+    q: queue.Queue = queue.Queue(maxsize=maxsize)
+    stop = threading.Event()
+    _END = object()
+
+    def _put(item) -> bool:
+        # Bounded put that gives up when the consumer is gone — a plain
+        # q.put would block forever on the full queue.
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def runner():
+        try:
+            produce(_put)
+            _put(_END)
+        except BaseException as e:  # propagate, don't truncate the epoch
+            _put(e)
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        t.join(timeout=5.0)
 
 
 def device_prefetch(mesh, batch_iter, with_mask: bool = False,
@@ -28,29 +87,22 @@ def device_prefetch(mesh, batch_iter, with_mask: bool = False,
     ``(images, labels)`` for the train step, or with ``with_mask``
     ``(images, labels, mask)`` for the eval step.
     """
-    q: queue.Queue = queue.Queue(maxsize=depth)
-    _END = object()
 
-    def producer():
-        try:
-            for batch in batch_iter:
-                if with_mask:
-                    q.put(shard_batch(mesh, batch.images, batch.labels,
-                                      batch.mask))
-                else:
-                    q.put(shard_batch(mesh, batch.images, batch.labels))
-            q.put(_END)
-        except BaseException as e:  # propagate, don't truncate the epoch
-            q.put(e)
+    def produce(put):
+        for batch in batch_iter:
+            if with_mask:
+                item = shard_batch(mesh, batch.images, batch.labels,
+                                   batch.mask)
+            else:
+                item = shard_batch(mesh, batch.images, batch.labels)
+            if not put(item):
+                return
 
-    t = threading.Thread(target=producer, daemon=True)
-    t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            break
-        if isinstance(item, BaseException):
-            t.join()
-            raise item
-        yield item
-    t.join()
+    try:
+        yield from iter_with_producer(produce, depth)
+    finally:
+        # Close the source iterator so its own resources (decode pools,
+        # producer threads) unwind deterministically too.
+        close = getattr(batch_iter, "close", None)
+        if close is not None:
+            close()

@@ -21,7 +21,6 @@ the reference's ``torch.cuda.synchronize()`` every step
 
 from __future__ import annotations
 
-import itertools
 import os
 import signal
 import time
@@ -93,10 +92,12 @@ def _stop_agreed(stop_check, step_i: int) -> bool:
     Single-host: poll every step. Multi-host: polling per-process could
     desynchronize the pod (one process enters the collective checkpoint
     save while another dispatches one more train_step — mismatched
-    collectives hang). Instead, every 8 steps all processes take process
-    0's flag via a broadcast collective, so every host breaks at the
-    SAME step boundary. (Slurm delivers the signal to all tasks; process
-    0's observation is the decision bit.)
+    collectives hang). Instead, every 8 steps the per-process flags are
+    ANY-reduced (allgather + max), so every host breaks at the SAME
+    step boundary. Any-reduce, not a rank-0 broadcast: Slurm delivers
+    the signal to every task, but Cloud TPU per-VM preemption notices
+    can land on a single non-zero host — its flag must still stop the
+    whole pod, or that host dies without the mid-epoch checkpoint.
     """
     if stop_check is None:
         return False
@@ -105,8 +106,21 @@ def _stop_agreed(stop_check, step_i: int) -> bool:
     if step_i % 8:
         return False
     from jax.experimental import multihost_utils
-    flag = np.array(1 if stop_check() else 0, np.int32)
-    return bool(multihost_utils.broadcast_one_to_all(flag))
+    flag = np.array([1 if stop_check() else 0], np.int32)
+    return bool(multihost_utils.process_allgather(flag).max())
+
+
+def _skip_batches(it, n: int):
+    """Skip the first ``n`` items, forwarding close() to the source so
+    early generator exit still unwinds the loader's producer thread."""
+    try:
+        for i, item in enumerate(it):
+            if i >= n:
+                yield item
+    finally:
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()
 
 
 def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
@@ -131,7 +145,10 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
     steps_done = start_step
     it = loader.epoch(epoch)
     if start_step:
-        it = itertools.islice(it, start_step, None)
+        # NOT itertools.islice: islice has no close(), which would sever
+        # device_prefetch's deterministic unwind of the loader's decode
+        # thread exactly on the resumed-then-interrupted-again path.
+        it = _skip_batches(it, start_step)
     t_fetch = time.time()
     # Batches arrive as device arrays staged one step ahead (H2D
     # overlapped with the running step, data/prefetch.py).
@@ -261,9 +278,15 @@ def run(cfg: Config, stop_check=None) -> dict:
     use_ep = cfg.expert_parallel
     if cfg.moe_every and not cfg.arch.startswith("vit"):
         raise ValueError("--moe-every requires a ViT arch")
-    if cfg.moe_every and (use_sp or use_pp or use_tp):
-        raise ValueError("MoE composes with data parallelism (and "
-                         "--expert-parallel); not with sp/pp/tp")
+    if cfg.moe_every and (use_sp or use_tp):
+        raise ValueError("MoE composes with data parallelism, "
+                         "--expert-parallel, and (at --moe-every 1) "
+                         "pipeline stages; not with sp/tp")
+    if cfg.moe_every and use_pp and not (cfg.moe_every == 1 and use_ep):
+        raise ValueError(
+            "MoE inside pipeline stages requires --moe-every 1 (the "
+            "nn.scan stage stack must be homogeneous) and "
+            "--expert-parallel (experts ride the model axis)")
     if use_ep and (not cfg.moe_every or cfg.model_parallel < 2):
         raise ValueError("--expert-parallel requires --moe-every > 0 and "
                          "--model-parallel >= 2")
@@ -299,14 +322,19 @@ def run(cfg: Config, stop_check=None) -> dict:
         moe_kw = dict(moe_every=cfg.moe_every, num_experts=cfg.num_experts,
                       capacity_factor=cfg.capacity_factor,
                       moe_groups=cfg.moe_groups, moe_top_k=cfg.moe_top_k)
+        pp_kw = (dict(pipe_axis=cluster.PIPE_AXIS,
+                      microbatches=cfg.microbatches) if use_pp else {})
         model = create_model(
             cfg.arch, cfg.num_classes, cfg.bf16, attn_impl=cfg.attn,
-            expert_axis=cluster.MODEL_AXIS if use_ep else None, **moe_kw, remat=cfg.remat)
+            expert_axis=cluster.MODEL_AXIS if use_ep else None,
+            **moe_kw, **pp_kw, remat=cfg.remat)
         # Host-side init twin: same param tree; EP consumes slices of it.
         # groups=1 — params don't depend on the capacity grouping, and
         # the init batch (2 images) need not divide the run's groups.
+        # Under pp the twin is the layer-stacked pipe-free model.
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                                   attn_impl=cfg.attn,
+                                  **({"stacked": True} if use_pp else {}),
                                   **{**moe_kw, "moe_groups": 1}, remat=cfg.remat)
     elif use_pp:
         model = create_model(
@@ -358,16 +386,18 @@ def run(cfg: Config, stop_check=None) -> dict:
     elif cfg.zero1:
         from imagent_tpu.parallel.zero import zero1_state_specs
         state_specs = zero1_state_specs(state)
-    elif use_ep:
-        from imagent_tpu.parallel.expert_parallel import vit_moe_param_specs
-        state_specs = state_partition_specs(
-            state, vit_moe_param_specs(state.params))
     elif use_pp:
+        # pp (optionally composed with tp OR ep on the model axis).
         from imagent_tpu.parallel.pipeline import vit_pp_param_specs
         state_specs = state_partition_specs(
             state, vit_pp_param_specs(
                 state.params,
-                tp_axis=cluster.MODEL_AXIS if use_tp else None))
+                tp_axis=cluster.MODEL_AXIS if use_tp else None,
+                expert_axis=cluster.MODEL_AXIS if use_ep else None))
+    elif use_ep:
+        from imagent_tpu.parallel.expert_parallel import vit_moe_param_specs
+        state_specs = state_partition_specs(
+            state, vit_moe_param_specs(state.params))
     elif use_tp:
         from imagent_tpu.parallel.tensor_parallel import vit_tp_param_specs
         state_specs = state_partition_specs(
@@ -406,6 +436,37 @@ def run(cfg: Config, stop_check=None) -> dict:
             # the interrupted epoch are already applied; resume skips
             # exactly those batches (deterministic loader order).
             resume_step = int(meta.get("resume_step", 0))
+            if resume_step > 0:
+                # The skipped-batch bookkeeping is only valid on the
+                # loader order it was recorded under — a pure function
+                # of (seed, epoch, process_count, global_batch).
+                recorded = {"global_batch": int(meta.get("global_batch", 0)),
+                            "process_count": int(
+                                meta.get("process_count", 0)),
+                            "seed": int(meta.get("seed", -1))}
+                current = {"global_batch": global_batch,
+                           "process_count": jax.process_count(),
+                           "seed": cfg.seed}
+                if recorded["global_batch"] == 0:
+                    if is_master:
+                        print("WARNING: mid-epoch checkpoint predates "
+                              "topology recording; cannot verify the "
+                              "resumed loader order matches", flush=True)
+                elif recorded != current:
+                    raise ValueError(
+                        f"mid-epoch resume topology mismatch: checkpoint "
+                        f"was written under {recorded} but this run is "
+                        f"{current} — resuming would skip the wrong "
+                        f"batches (some gradients twice, others never). "
+                        f"Restart the epoch (delete the 'last' "
+                        f"checkpoint's resume_step) or match the "
+                        f"original topology.")
+                if resume_step >= train_loader.steps_per_epoch:
+                    raise ValueError(
+                        f"recorded resume_step {resume_step} >= "
+                        f"{train_loader.steps_per_epoch} steps/epoch — "
+                        "the dataset or batch geometry changed since "
+                        "the interrupted run")
             best_top1 = float(meta.get("best_top1", 0.0))
             best_top5 = float(meta.get("best_top5", 0.0))
             best_epoch = int(meta.get("best_epoch", -1))
@@ -421,6 +482,10 @@ def run(cfg: Config, stop_check=None) -> dict:
         jax.profiler.start_trace(cfg.log_dir)
 
     run_t0 = time.time()
+    # Written into every checkpoint meta: the loader-order fingerprint a
+    # mid-epoch resume must match (see the resume guard above).
+    topo_meta = {"global_batch": global_batch,
+                 "process_count": jax.process_count(), "seed": cfg.seed}
     train_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
     val_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
     preempted = False
@@ -456,7 +521,7 @@ def run(cfg: Config, stop_check=None) -> dict:
             ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.LAST, state, {
                 "epoch": epoch - 1, "resume_step": interrupted_at,
                 "best_top1": best_top1, "best_top5": best_top5,
-                "best_epoch": best_epoch})
+                "best_epoch": best_epoch, **topo_meta})
             if is_master:
                 print(f"preemption signal: checkpointed epoch {epoch + 1} "
                       f"at step {interrupted_at}; exiting cleanly "
@@ -475,13 +540,14 @@ def run(cfg: Config, stop_check=None) -> dict:
             if cfg.save_model:
                 ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.BEST, state, {
                     "epoch": epoch, "best_top1": best_top1,
-                    "best_top5": best_top5, "best_epoch": best_epoch})
+                    "best_top5": best_top5, "best_epoch": best_epoch,
+                    **topo_meta})
         if cfg.save_model:
             # Async: the next epoch trains while LAST serializes.
             ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.LAST, state, {
                 "epoch": epoch, "best_top1": best_top1,
-                "best_top5": best_top5, "best_epoch": best_epoch},
-                block=False)
+                "best_top5": best_top5, "best_epoch": best_epoch,
+                **topo_meta}, block=False)
         logger.epoch_summary(epoch, lr, train_m,
                              val_m if did_eval else None, train_t, val_t)
         logger.scalars(epoch, lr, train_m, val_m if did_eval else None)

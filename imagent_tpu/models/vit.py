@@ -271,6 +271,10 @@ VIT_REGISTRY = {
                     num_heads=16, mlp_dim=4096),
     "vit_h14": dict(patch_size=14, hidden_dim=1280, num_layers=32,
                     num_heads=16, mlp_dim=5120),
+    # Debug-scale arch: lets the full engine surface (pp/tp/ep/moe CLI
+    # paths) run end-to-end on a CPU mesh in seconds — not a real model.
+    "vit_debug": dict(patch_size=8, hidden_dim=32, num_layers=2,
+                      num_heads=4, mlp_dim=64),
 }
 
 # torchvision reference param counts at 1000 classes (no vit_h14 entry:

@@ -71,6 +71,15 @@ def main() -> int:
     _, metrics = step(state, gi, gl, np.float32(0.05))
     m = np.asarray(metrics)
     print("METRICS", " ".join(f"{x:.6f}" for x in m), flush=True)
+
+    # Preemption any-reduce (ADVICE r1): a stop flag raised on a single
+    # NON-ZERO process (Cloud TPU per-VM preemption notice) must stop
+    # every process — and with no flag raised, nobody stops.
+    from imagent_tpu.engine import _stop_agreed
+    agreed_none = _stop_agreed(lambda: False, 0)
+    agreed_rank1 = _stop_agreed(lambda: rank == 1, 0)
+    print(f"STOPAGREE {int(agreed_none)} {int(agreed_rank1)}", flush=True)
+
     jax.distributed.shutdown()
     return 0
 

@@ -1,0 +1,115 @@
+"""Checkpoint robustness (ADVICE r1): legacy-layout restore and the
+mid-epoch resume topology guard."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from imagent_tpu import checkpoint as ckpt_lib
+from imagent_tpu.cluster import make_mesh
+from imagent_tpu.config import Config
+from imagent_tpu.engine import run
+from imagent_tpu.models import create_model
+from imagent_tpu.train import (
+    create_train_state, make_optimizer, replicate_state,
+)
+
+
+def _tiny_state():
+    model = create_model("resnet18", num_classes=4)
+    opt = make_optimizer()
+    return create_train_state(model, jax.random.key(0), 16, opt)
+
+
+def test_legacy_flat_layout_restores_with_sidecar_meta(tmp_path):
+    """A round-1 checkpoint (flat TrainState, meta only in the JSON
+    sidecar) must restore — not die inside Orbax with a tree mismatch."""
+    import json
+    import os
+
+    import orbax.checkpoint as ocp
+
+    state = replicate_state(_tiny_state(), make_mesh(model_parallel=1))
+    path = os.path.abspath(str(tmp_path / "last"))
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, state)  # the OLD layout: no {state, meta} nesting
+    ckptr.wait_until_finished()
+    with open(str(tmp_path / "last_meta.json"), "w") as f:
+        json.dump({"epoch": 3, "best_top1": 41.5, "best_epoch": 2}, f)
+
+    restored = ckpt_lib.restore(str(tmp_path), "last", state)
+    assert restored is not None
+    got_state, meta = restored
+    assert meta["epoch"] == 3 and meta["best_top1"] == 41.5
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(got_state.params["conv1"]["kernel"])),
+        np.asarray(jax.device_get(state.params["conv1"]["kernel"])))
+
+
+def test_wrong_arch_still_fails_loudly(tmp_path):
+    """The legacy fallback must NOT mask genuine shape mismatches."""
+    state = replicate_state(_tiny_state(), make_mesh(model_parallel=1))
+    ckpt_lib.save(str(tmp_path), "last", state, {"epoch": 0})
+    other = replicate_state(
+        create_train_state(create_model("resnet34", num_classes=4),
+                           jax.random.key(0), 16, make_optimizer()),
+        make_mesh(model_parallel=1))
+    with pytest.raises(Exception, match="arch|shape|match|structure"):
+        ckpt_lib.restore(str(tmp_path), "last", other)
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(arch="resnet18", image_size=16, num_classes=4, batch_size=4,
+                epochs=2, lr=0.05, dataset="synthetic", synthetic_size=128,
+                workers=0, bf16=False, log_every=0, seed=0, save_model=True,
+                log_dir=str(tmp_path / "tb"), ckpt_dir=str(tmp_path / "ck"))
+    base.update(kw)
+    return Config(**base)
+
+
+def test_mid_epoch_resume_topology_mismatch_rejected(tmp_path):
+    """A mid-epoch (resume_step > 0) checkpoint records its loader-order
+    fingerprint (global_batch, process_count, seed); resuming under a
+    different one must fail loudly, not silently skip wrong batches."""
+    calls = {"n": 0}
+
+    def stop_after_two(n=2):
+        calls["n"] += 1
+        return calls["n"] > n
+
+    result = run(_cfg(tmp_path), stop_check=stop_after_two)
+    assert result["preempted"] is True
+
+    with pytest.raises(ValueError, match="topology mismatch"):
+        run(_cfg(tmp_path, resume=True, seed=1))  # different seed
+    with pytest.raises(ValueError, match="topology mismatch"):
+        run(_cfg(tmp_path, resume=True, batch_size=8))  # different batch
+    # Matching topology resumes fine.
+    result = run(_cfg(tmp_path, resume=True))
+    assert result["preempted"] is False
+
+
+def test_prior_five_field_meta_layout_restores(tmp_path):
+    """A checkpoint from the previous framework version ({state, meta}
+    layout but without the topology fields) must restore with the new
+    fields defaulting — not die with a tree mismatch."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    state = replicate_state(_tiny_state(), make_mesh(model_parallel=1))
+    path = os.path.abspath(str(tmp_path / "last"))
+    old_meta = {"epoch": np.int64(4), "best_top1": np.float64(39.0),
+                "best_top5": np.float64(70.0), "best_epoch": np.int64(4),
+                "resume_step": np.int64(0)}
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, {"state": state, "meta": old_meta})
+    ckptr.wait_until_finished()
+
+    restored = ckpt_lib.restore(str(tmp_path), "last", state)
+    assert restored is not None
+    _, meta = restored
+    assert meta["epoch"] == 4 and meta["best_top1"] == 39.0
+    assert meta["global_batch"] == 0  # new field defaults
+    assert meta["seed"] == -1

@@ -204,3 +204,45 @@ def test_texturegen_deterministic_and_cached(tmp_path):
                          val_per_class=2, img=32)
     assert open(f, "rb").read() == first
     assert (texture(0, 1, 2, 32) == texture(0, 1, 2, 32)).all()
+
+
+def test_early_exit_releases_producer_threads(tmp_path):
+    """ADVICE r1: breaking out of an epoch mid-stream (preemption, step
+    exception) must not leave producer threads blocked on a full queue —
+    both the host-batch stage (ImageFolderLoader.epoch) and the device
+    stage (device_prefetch) unwind via GeneratorExit."""
+    import threading
+    import time as _time
+
+    import jax
+
+    from imagent_tpu.cluster import make_mesh
+    from imagent_tpu.config import Config
+    from imagent_tpu.data.imagefolder import ImageFolderLoader
+    from imagent_tpu.data.prefetch import device_prefetch
+    from imagent_tpu.data.texturegen import generate_imagefolder
+
+    root = str(tmp_path / "ds")
+    generate_imagefolder(root, n_classes=2, train_per_class=24,
+                         val_per_class=2, img=32)
+    cfg = Config(dataset="imagefolder", data_root=root, image_size=16,
+                 num_classes=2, batch_size=1, workers=0, seed=0)
+    loader = ImageFolderLoader(cfg, 0, 1, global_batch=8, split="train")
+    mesh = make_mesh(model_parallel=1)
+    baseline = threading.active_count()
+
+    # One batch from a 6-step epoch, then break — twice, both stages.
+    for _ in range(2):
+        it = device_prefetch(mesh, loader.epoch(0))
+        next(it)
+        it.close()  # what an interrupted for-loop does on gc
+
+    deadline = _time.time() + 10
+    while threading.active_count() > baseline and _time.time() < deadline:
+        _time.sleep(0.05)
+    assert threading.active_count() <= baseline, (
+        f"{threading.active_count() - baseline} producer thread(s) leaked")
+    # The loader remains usable for the next (resumed) epoch.
+    n = sum(1 for _ in loader.epoch(1))
+    assert n == loader.steps_per_epoch
+    loader.close()

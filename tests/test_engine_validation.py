@@ -31,6 +31,11 @@ def _cfg(**kw):
     (dict(moe_every=2), "--moe-every requires a ViT"),
     (dict(arch="vit_b16", moe_every=2, tensor_parallel=True,
           model_parallel=2), "MoE composes"),
+    (dict(arch="vit_b16", moe_every=2, pipeline_parallel=2,
+          expert_parallel=True, model_parallel=2),
+     "MoE inside pipeline stages requires --moe-every 1"),
+    (dict(arch="vit_b16", moe_every=1, pipeline_parallel=2),
+     "MoE inside pipeline stages"),
     (dict(arch="vit_b16", expert_parallel=True), "--expert-parallel"),
     (dict(zero1=True, model_parallel=2, arch="vit_b16",
           tensor_parallel=True), "--zero1"),
@@ -40,3 +45,18 @@ def _cfg(**kw):
 def test_invalid_combinations_rejected(kw, match):
     with pytest.raises(ValueError, match=match):
         run(_cfg(**kw))
+
+
+def test_moe_pp_ep_reachable_from_cli(tmp_path):
+    """ADVICE r1 (medium): pp x ep was library-only — the documented
+    operator surface must reach it. Full engine run on the debug arch:
+    mesh (data=2, pipe=2, model=2), MoE every layer, experts on the
+    model axis."""
+    cfg = _cfg(arch="vit_debug", image_size=16, moe_every=1,
+               num_experts=4, expert_parallel=True, model_parallel=2,
+               pipeline_parallel=2, microbatches=2, batch_size=4,
+               epochs=2, lr=0.05,
+               log_dir=str(tmp_path / "tb"), ckpt_dir=str(tmp_path / "ck"))
+    result = run(cfg)
+    assert result["best_epoch"] >= 0
+    assert result["final_train"]["n"] > 0

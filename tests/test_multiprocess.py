@@ -62,6 +62,13 @@ def test_two_process_train_step_matches_single():
     np.testing.assert_allclose(metrics[0], metrics[1], rtol=1e-6)
     assert metrics[0][3] == 8.0  # psum'd count spans both processes
 
+    # Preemption any-reduce: both ranks must agree "no stop" with no
+    # flag, and BOTH must stop when only rank 1 raised the flag.
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("STOPAGREE")]
+        assert line, out
+        assert line[0].split()[1:] == ["0", "1"], out
+
     # Single-process reference on the same concatenated batch.
     import jax
 
