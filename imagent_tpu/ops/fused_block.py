@@ -1,0 +1,125 @@
+"""Pallas TPU fused ResNet bottleneck block — a MEASURED NEGATIVE RESULT.
+
+This kernel tested the roofline hypothesis (docs/ROOFLINE.md) that a
+stride-1 bottleneck —
+
+    y = relu(x + conv1x1_c(relu(bn2(conv3x3(relu(bn1(conv1x1_a(x))))))))
+
+— computed in ONE pass (the 1x1-conv intermediates resident in VMEM, the
+3x3 as 9 shifted MXU matmuls over the whole tiny spatial extent, HBM
+touched only for the x read and y write) would beat XLA's per-conv
+schedule. **It does not**: measured on v5e
+(``benchmarks/fused_block.py``), XLA runs the 14x14/7x7 blocks at or
+above the analytic compute peak (a cheaper 3x3 algorithm + near-perfect
+scheduling), so those blocks are compute-bound and this kernel is
+0.35-0.78x of XLA. Kept in-tree as the documented evidence (see
+ROOFLINE.md "attempted, measured, rejected"), as a correctness-pinned
+Pallas conv-block template, and for re-evaluation on future
+chip/compiler generations. Do NOT wire it into the model paths on
+current hardware.
+
+Scope: inference/eval numerics (BatchNorm folded into conv weights +
+bias by ``fold_bn`` — exact in eval mode; train-mode BN would need
+cross-tile batch statistics mid-block). Correctness is pinned against
+the unfused XLA computation and the real flax ``Bottleneck`` module in
+``tests/test_fused_block.py`` (interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def fold_bn(kernel, scale, bias, mean, var, eps: float = 1e-5):
+    """Fold eval-mode BatchNorm into the preceding conv: returns
+    (kernel', bias') with kernel' = kernel * s, bias' = b - mean * s,
+    s = scale / sqrt(var + eps). Exact for use_running_average=True."""
+    s = scale / jnp.sqrt(var + eps)
+    return kernel * s, bias - mean * s
+
+
+def _kernel(x_ref, w1_ref, b1_ref, w3_ref, b3_ref, wc_ref, bc_ref, o_ref,
+            *, h: int, w: int):
+    """One batch tile: the full bottleneck in VMEM.
+
+    Shapes (C = block input channels, F = bottleneck width):
+      x (bt, h, w, C) | w1 (C, F) | w3 (3, 3, F, F) | wc (F, C)
+    """
+    bt = x_ref.shape[0]
+    f = w1_ref.shape[1]
+    x = x_ref[...]
+    xm = x.reshape(bt * h * w, x.shape[-1])
+
+    # 1x1 reduce + folded BN + relu (MXU, fp32 accumulate).
+    y1 = jnp.dot(xm, w1_ref[...],
+                 preferred_element_type=jnp.float32) + b1_ref[...]
+    y1 = jnp.maximum(y1, 0.0).astype(x.dtype)
+
+    # 3x3 same-padding conv as 9 shifted matmuls over the resident
+    # spatial extent (no halos: the whole h x w tile is in VMEM).
+    y1 = y1.reshape(bt, h, w, f)
+    y1p = jnp.pad(y1, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    acc = jnp.zeros((bt * h * w, f), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            win = y1p[:, dy:dy + h, dx:dx + w, :].reshape(bt * h * w, f)
+            acc += jnp.dot(win, w3_ref[dy, dx],
+                           preferred_element_type=jnp.float32)
+    y2 = jnp.maximum(acc + b3_ref[...], 0.0).astype(x.dtype)
+
+    # 1x1 expand + folded BN + residual + relu.
+    y3 = jnp.dot(y2, wc_ref[...],
+                 preferred_element_type=jnp.float32) + bc_ref[...]
+    out = jnp.maximum(y3 + xm.astype(jnp.float32), 0.0)
+    o_ref[...] = out.reshape(bt, h, w, x.shape[-1]).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("batch_tile", "interpret"))
+def fused_bottleneck(x, w1, b1, w3, b3, wc, bc, *, batch_tile: int = 8,
+                     interpret: bool = False):
+    """Fused stride-1 identity bottleneck (eval-mode, BN pre-folded).
+
+    ``x``: (B, H, W, C); ``w1``: (C, F); ``w3``: (3, 3, F, F);
+    ``wc``: (F, C); biases fp32. B must divide by ``batch_tile``.
+    """
+    b, h, w, c = x.shape
+    f = w1.shape[1]
+    if b % batch_tile:
+        raise ValueError(f"batch {b} not divisible by tile {batch_tile}")
+    grid = (b // batch_tile,)
+    kern = functools.partial(_kernel, h=h, w=w)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((batch_tile, h, w, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((c, f), lambda i: (0, 0)),
+            pl.BlockSpec((f,), lambda i: (0,)),
+            pl.BlockSpec((3, 3, f, f), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((f,), lambda i: (0,)),
+            pl.BlockSpec((f, c), lambda i: (0, 0)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((batch_tile, h, w, c),
+                               lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x, w1, b1, w3, b3, wc, bc)
+
+
+def reference_bottleneck(x, w1, b1, w3, b3, wc, bc):
+    """The same computation as unfused XLA ops (the parity oracle and
+    the benchmark baseline)."""
+    y = jnp.dot(x, w1, preferred_element_type=jnp.float32) + b1
+    y = jnp.maximum(y, 0.0).astype(x.dtype)
+    y = jax.lax.conv_general_dilated(
+        y, w3, (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32) + b3
+    y = jnp.maximum(y, 0.0).astype(x.dtype)
+    y = jnp.dot(y, wc, preferred_element_type=jnp.float32) + bc
+    return jnp.maximum(y + x.astype(jnp.float32), 0.0).astype(x.dtype)

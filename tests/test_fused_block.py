@@ -1,0 +1,96 @@
+"""Fused bottleneck kernel (ops/fused_block.py): parity vs the unfused
+XLA computation, BN folding exactness, and the flax-model equivalence
+(eval-mode Bottleneck block == fused kernel with folded BN)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from imagent_tpu.ops.fused_block import (
+    fold_bn, fused_bottleneck, reference_bottleneck,
+)
+
+B, H, W, C, F = 8, 14, 14, 128, 32
+
+
+def _weights(rng):
+    return (
+        jnp.asarray(rng.normal(size=(C, F)) * 0.1, jnp.float32),
+        jnp.asarray(rng.normal(size=(F,)) * 0.1, jnp.float32),
+        jnp.asarray(rng.normal(size=(3, 3, F, F)) * 0.1, jnp.float32),
+        jnp.asarray(rng.normal(size=(F,)) * 0.1, jnp.float32),
+        jnp.asarray(rng.normal(size=(F, C)) * 0.1, jnp.float32),
+        jnp.asarray(rng.normal(size=(C,)) * 0.1, jnp.float32),
+    )
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fused_matches_reference(dtype):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, H, W, C)), dtype)
+    w1, b1, w3, b3, wc, bc = _weights(rng)
+    w1, w3, wc = (a.astype(dtype) for a in (w1, w3, wc))
+    got = fused_bottleneck(x, w1, b1, w3, b3, wc, bc,
+                           batch_tile=4, interpret=True)
+    want = reference_bottleneck(x, w1, b1, w3, b3, wc, bc)
+    assert got.dtype == want.dtype == dtype
+    tol = 1e-5 if dtype == jnp.float32 else 3e-2
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=tol, atol=tol)
+
+
+def test_fold_bn_exactness():
+    """conv+eval-BN == folded conv+bias, to fp32 exactness."""
+    rng = np.random.default_rng(1)
+    k = jnp.asarray(rng.normal(size=(C, F)), jnp.float32)
+    scale = jnp.asarray(rng.uniform(0.5, 2.0, (F,)), jnp.float32)
+    bias = jnp.asarray(rng.normal(size=(F,)), jnp.float32)
+    mean = jnp.asarray(rng.normal(size=(F,)), jnp.float32)
+    var = jnp.asarray(rng.uniform(0.1, 2.0, (F,)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(5, C)), jnp.float32)
+    want = (x @ k - mean) / jnp.sqrt(var + 1e-5) * scale + bias
+    kf, bf = fold_bn(k, scale, bias, mean, var)
+    np.testing.assert_allclose(np.asarray(x @ kf + bf), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_matches_flax_bottleneck_eval():
+    """End-to-end oracle: our Bottleneck module in eval mode (stride 1,
+    identity skip) == the fused kernel with BN folded from its params."""
+    from functools import partial
+
+    import flax.linen as nn
+
+    from imagent_tpu.models.resnet import Bottleneck
+
+    rng = np.random.default_rng(2)
+    conv = partial(nn.Conv, use_bias=False)
+    norm = partial(nn.BatchNorm, use_running_average=True, momentum=0.9,
+                   epsilon=1e-5)
+    block = Bottleneck(filters=F, conv=conv, norm=norm, strides=1,
+                       expansion=C // F)
+    x = jnp.asarray(rng.normal(size=(4, H, W, C)), jnp.float32)
+    variables = block.init(jax.random.key(0), x)
+    # Perturb BN stats away from init (mean 0 / var 1) so folding is
+    # actually exercised.
+    bs = jax.tree.map(
+        lambda a: a + 0.1 * jnp.arange(a.size, dtype=a.dtype).reshape(
+            a.shape) / a.size, variables["batch_stats"])
+    p = variables["params"]
+    want = block.apply({"params": p, "batch_stats": bs}, x)
+
+    def folded(conv_name, bn_name, kernel_2d):
+        k = p[conv_name]["kernel"]
+        k = k.reshape(kernel_2d) if kernel_2d else k
+        return fold_bn(k, p[bn_name]["scale"], p[bn_name]["bias"],
+                       bs[bn_name]["mean"], bs[bn_name]["var"])
+
+    w1, b1 = folded("Conv_0", "BatchNorm_0", (C, F))
+    w3, b3 = folded("Conv_1", "BatchNorm_1", None)
+    wc, bc = folded("Conv_2", "BatchNorm_2", (F, C))
+    got = fused_bottleneck(x, w1, b1, w3, b3, wc, bc,
+                           batch_tile=4, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
