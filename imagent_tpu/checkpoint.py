@@ -50,7 +50,10 @@ _META_FIELDS = (
 )
 
 _ckptr: ocp.StandardCheckpointer | None = None
-_pending_meta: tuple[str, str, dict] | None = None
+_pending_commit: tuple[str, str, dict] | None = None
+
+_STAGING = ".staging"  # never restored; the in-flight write target
+_OLD = ".old"          # previous checkpoint during the commit swap
 
 
 def _checkpointer() -> ocp.StandardCheckpointer:
@@ -70,35 +73,68 @@ def _write_meta(ckpt_dir: str, name: str, meta: dict) -> None:
             json.dump(meta, f)
 
 
-def _flush_pending() -> None:
-    global _pending_meta
-    if _pending_meta is not None:
-        _write_meta(*_pending_meta)
-        _pending_meta = None
+def _commit(ckpt_dir: str, name: str, meta: dict) -> None:
+    """Swap the finalized staging checkpoint into the live name.
+
+    The live checkpoint is NEVER the write target (a process killed
+    mid-async-save must not destroy the last durable state — an Orbax
+    ``save(path, force=True)`` clears ``path`` long before the new data
+    is complete, which is exactly the preemption-durability hole this
+    dance closes). Worst crash case here leaves ``name.old`` + staging,
+    both handled by ``restore``."""
+    import shutil
+
+    if jax.process_index() == 0:
+        staging = os.path.join(ckpt_dir, name + _STAGING)
+        live = os.path.join(ckpt_dir, name)
+        old = os.path.join(ckpt_dir, name + _OLD)
+        if os.path.isdir(live):
+            # Clear .old only when a live checkpoint is about to replace
+            # it — if live is absent (recovering from a prior mid-commit
+            # crash), .old IS the only durable state and must survive
+            # until the new live lands.
+            shutil.rmtree(old, ignore_errors=True)
+            os.rename(live, old)
+        os.rename(staging, live)
+        shutil.rmtree(old, ignore_errors=True)
+        _write_meta(ckpt_dir, name, meta)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt_commit_{name}")
+
+
+def _land_pending() -> None:
+    global _pending_commit
+    if _pending_commit is not None:
+        _commit(*_pending_commit)
+        _pending_commit = None
 
 
 def wait_until_finished() -> None:
-    """Block until any in-flight async save is durable (and its meta
-    sidecar written). Call before reading a just-written checkpoint and
-    at the end of a run."""
+    """Block until any in-flight async save is durable (committed to its
+    live name, meta sidecar written). Call before reading a just-written
+    checkpoint and at the end of a run."""
     _checkpointer().wait_until_finished()
-    _flush_pending()
+    _land_pending()
 
 
 def save(ckpt_dir: str, name: str, state: TrainState, meta: dict,
          block: bool = True) -> None:
     """Write checkpoint + sidecar metadata. Multi-host safe: Orbax
-    coordinates across processes; the JSON sidecar is process-0 only.
-    ``block=False`` returns after staging; the background finalize and
-    the meta write complete on the next save/wait (see module docstring).
+    coordinates across processes; the sidecar + commit swap are
+    process-0 with a cross-host barrier. ``block=False`` returns after
+    staging; the background finalize, the commit swap, and the meta
+    write complete on the next save/wait (see module docstring).
     """
-    global _pending_meta
-    path = os.path.abspath(os.path.join(ckpt_dir, name))
+    global _pending_commit
+    ckpt_dir = os.path.abspath(ckpt_dir)  # commit may land after a cwd
+    # change; staging/live/old must resolve identically then.
+    staging = os.path.join(ckpt_dir, name + _STAGING)
     ckptr = _checkpointer()
     # Only one save may be in flight; landing the previous one also
-    # flushes its sidecar in the correct order.
+    # commits its staging dir and sidecar in the correct order.
     ckptr.wait_until_finished()
-    _flush_pending()
+    _land_pending()
     # Hand Orbax the jax.Arrays as-is: it gathers sharded leaves itself
     # (a tensor-parallel state spans hosts — a host-side device_get here
     # would crash on non-addressable shards). Meta rides in-tree so it
@@ -106,12 +142,12 @@ def save(ckpt_dir: str, name: str, state: TrainState, meta: dict,
     tree = {"state": state,
             "meta": {k: np.asarray(meta.get(k, default), dtype)
                      for k, dtype, default in _META_FIELDS}}
-    ckptr.save(path, tree, force=True)
+    ckptr.save(staging, tree, force=True)
     if block:
         ckptr.wait_until_finished()
-        _write_meta(ckpt_dir, name, meta)
+        _commit(ckpt_dir, name, meta)
     else:
-        _pending_meta = (ckpt_dir, name, meta)
+        _pending_commit = (ckpt_dir, name, meta)
 
 
 def _sidecar_meta(ckpt_dir: str, name: str) -> dict:
@@ -138,7 +174,16 @@ def restore(ckpt_dir: str, name: str,
     wait_until_finished()  # a just-written checkpoint must be durable
     path = os.path.abspath(os.path.join(ckpt_dir, name))
     if not os.path.isdir(path):
-        return None
+        # Crash window between the commit renames: the previous durable
+        # checkpoint survives under name.old — restore it. (A leftover
+        # .staging dir is an INCOMPLETE write and is never restored.)
+        old = os.path.abspath(os.path.join(ckpt_dir, name + _OLD))
+        if not os.path.isdir(old):
+            return None
+        print(f"NOTE: {path} missing (crash during checkpoint commit); "
+              f"restoring the previous durable checkpoint {old}",
+              flush=True)
+        path = old
     ckptr = ocp.StandardCheckpointer()
     state_abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), target)

@@ -23,16 +23,20 @@ import os
 import numpy as np
 
 
-def texture(cls: int, idx: int, n_classes: int, img: int) -> np.ndarray:
-    """Deterministic RGB texture for (class, index)."""
+def texture(cls: int, idx: int, n_classes: int, img: int,
+            hue_jitter: float = 0.03) -> np.ndarray:
+    """Deterministic RGB texture for (class, index). ``hue_jitter``
+    controls task difficulty: within-class hue spread vs the 1/n_classes
+    class separation (many classes + small jitter approaches the JPEG
+    chroma-quantization floor)."""
     rng = np.random.default_rng(cls * 100_003 + idx)
     yy, xx = np.mgrid[0:img, 0:img].astype(np.float32)
     phase = rng.uniform(0, 2 * np.pi)
     wavelength = rng.uniform(10, 18) * img / 64.0
     theta = rng.uniform(0, np.pi)
     base = np.asarray(colorsys.hsv_to_rgb(
-        (cls / n_classes + rng.uniform(-0.03, 0.03)) % 1.0, 0.85, 0.8),
-        np.float32)
+        (cls / n_classes + rng.uniform(-hue_jitter, hue_jitter)) % 1.0,
+        0.85, 0.8), np.float32)
     wave = np.sin(2 * np.pi * (xx * np.cos(theta) + yy * np.sin(theta))
                   / wavelength + phase)
     lum = 0.75 + 0.25 * wave
@@ -43,14 +47,15 @@ def texture(cls: int, idx: int, n_classes: int, img: int) -> np.ndarray:
 
 def generate_imagefolder(root: str, n_classes: int = 8,
                          train_per_class: int = 40, val_per_class: int = 8,
-                         img: int = 64, quality: int = 90) -> str:
+                         img: int = 64, quality: int = 90,
+                         hue_jitter: float = 0.03) -> str:
     """Write the dataset under ``root`` (idempotent: a manifest records
     the parameters; matching manifest ⇒ reuse, mismatch ⇒ regenerate)."""
     from PIL import Image
 
     manifest = dict(n_classes=n_classes, train_per_class=train_per_class,
                     val_per_class=val_per_class, img=img, quality=quality,
-                    version=1)
+                    hue_jitter=hue_jitter, version=1)
     mpath = os.path.join(root, "manifest.json")
     if os.path.exists(mpath):
         try:
@@ -71,8 +76,9 @@ def generate_imagefolder(root: str, n_classes: int = 8,
             d = os.path.join(root, split, f"class_{cls}")
             os.makedirs(d, exist_ok=True)
             for i in range(per_class):
-                Image.fromarray(texture(cls, base + i, n_classes, img)).save(
-                    os.path.join(d, f"{i:05d}.jpg"), quality=quality)
+                Image.fromarray(
+                    texture(cls, base + i, n_classes, img, hue_jitter)).save(
+                        os.path.join(d, f"{i:05d}.jpg"), quality=quality)
     with open(mpath, "w") as f:
         json.dump(manifest, f)
     return root

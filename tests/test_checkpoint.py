@@ -113,3 +113,56 @@ def test_prior_five_field_meta_layout_restores(tmp_path):
     assert meta["epoch"] == 4 and meta["best_top1"] == 39.0
     assert meta["global_batch"] == 0  # new field defaults
     assert meta["seed"] == -1
+
+
+def test_kill_during_async_save_preserves_previous(tmp_path):
+    """Durability under preemption-during-save (found by the round-2
+    run-of-record exercise): a process killed while an ASYNC save is in
+    flight must not destroy the previous durable checkpoint. The live
+    name is never the write target (staging + commit swap)."""
+    import os
+    import subprocess
+    import sys
+
+    worker = r"""
+import sys, os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+from imagent_tpu import checkpoint as ckpt_lib
+from imagent_tpu.cluster import make_mesh
+from imagent_tpu.models import create_model
+from imagent_tpu.train import (create_train_state, make_optimizer,
+                               replicate_state)
+d, mode = sys.argv[1], sys.argv[2]
+state = replicate_state(
+    create_train_state(create_model("resnet18", num_classes=4),
+                       jax.random.key(0), 16, make_optimizer()),
+    make_mesh(model_parallel=1))
+if mode == "first":
+    ckpt_lib.save(d, "last", state, {"epoch": 1}, block=True)
+elif mode == "kill_async":
+    ckpt_lib.save(d, "last", state, {"epoch": 2}, block=False)
+    os._exit(9)  # die mid-async-save, like a hard preemption
+elif mode == "check":
+    r = ckpt_lib.restore(d, "last", state)
+    print("RESTORED", "none" if r is None else r[1]["epoch"])
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)
+
+    def run_mode(mode, check_rc=True):
+        p = subprocess.run([sys.executable, "-c", worker, str(tmp_path),
+                            mode], env=env, capture_output=True, text=True,
+                           timeout=240,
+                           cwd=os.path.dirname(os.path.dirname(
+                               os.path.abspath(__file__))))
+        if check_rc:
+            assert p.returncode == 0, p.stdout + p.stderr
+        return p.stdout
+
+    run_mode("first")
+    run_mode("kill_async", check_rc=False)  # exits 9 by design
+    out = run_mode("check")
+    assert "RESTORED 1" in out, out
