@@ -270,8 +270,11 @@ def run(cfg: Config, stop_check=None) -> dict:
             "--tensor-parallel and --seq-parallel both consume the model "
             "axis; pick one")
     use_pp = cfg.pipeline_parallel > 1
-    if use_pp and not cfg.arch.startswith("vit"):
-        raise ValueError("--pipeline-parallel requires a ViT arch")
+    if (use_pp and not cfg.arch.startswith("vit")
+            and cfg.pipeline_parallel != 2):
+        raise ValueError("ResNet pipeline parallelism is 2-stage "
+                         "(--pipeline-parallel 2); deeper conv-stage "
+                         "pipelines need a ViT arch")
     if use_pp and use_sp:
         raise ValueError("--pipeline-parallel with --seq-parallel is not "
                          "supported; compose pp with --tensor-parallel")
@@ -336,6 +339,13 @@ def run(cfg: Config, stop_check=None) -> dict:
                                   attn_impl=cfg.attn,
                                   **({"stacked": True} if use_pp else {}),
                                   **{**moe_kw, "moe_groups": 1}, remat=cfg.remat)
+    elif use_pp and not cfg.arch.startswith("vit"):
+        # ResNet family: 2-stage GPipe over heterogeneous conv stages,
+        # params replicated over pipe (parallel/resnet_pipeline.py).
+        from imagent_tpu.parallel.resnet_pipeline import PipelinedResNet
+        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                                  remat=cfg.remat, stem=cfg.stem)
+        model = PipelinedResNet(init_model, cfg.microbatches)
     elif use_pp:
         model = create_model(
             cfg.arch, cfg.num_classes, cfg.bf16, attn_impl=cfg.attn,
@@ -386,6 +396,12 @@ def run(cfg: Config, stop_check=None) -> dict:
     elif cfg.zero1:
         from imagent_tpu.parallel.zero import zero1_state_specs
         state_specs = zero1_state_specs(state)
+    elif use_pp and not cfg.arch.startswith("vit"):
+        from imagent_tpu.parallel.resnet_pipeline import (
+            resnet_pp_param_specs,
+        )
+        state_specs = state_partition_specs(
+            state, resnet_pp_param_specs(state.params))
     elif use_pp:
         # pp (optionally composed with tp OR ep on the model axis).
         from imagent_tpu.parallel.pipeline import vit_pp_param_specs

@@ -125,6 +125,13 @@ class ResNet(nn.Module):
     # Param count differs (4*4*12*64 vs 7*7*3*64), so the torch
     # checkpoint-import path requires stem="v1" (the default).
     stem: str = "v1"
+    # Pipeline staging (parallel/resnet_pipeline.py): stage=None runs
+    # the whole network; stage=0 runs stem..layer[pipe_boundary] and
+    # returns the feature map; stage=1 consumes it and returns logits.
+    # Module names are explicit, so each stage's params are the exact
+    # corresponding SUBTREE of the full (stage=None) tree.
+    stage: int | None = None
+    pipe_boundary: int = 2  # residual stages in stage 0 (of 4)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -134,34 +141,42 @@ class ResNet(nn.Module):
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                        axis_name=None)  # per-replica stats = DDP semantics
         x = x.astype(self.dtype)
-        if self.stem not in ("v1", "s2d"):
-            raise ValueError(f"unknown stem {self.stem!r}; 'v1' or 's2d'")
-        if self.stem == "s2d":
-            b, h, w, c = x.shape
-            if h % 2 or w % 2:
+        if self.stage in (None, 0):
+            if self.stem not in ("v1", "s2d"):
                 raise ValueError(
-                    f"stem='s2d' needs even H/W (space-to-depth "
-                    f"rearrange), got {h}x{w}")
-            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
-            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2,
-                                                      4 * c)
-            # pad (2,1): exact receptive-field match of 7x7/s2 pad 3
-            x = conv(self.num_filters, (4, 4), (1, 1),
-                     padding=((2, 1), (2, 1)), name="conv1")(x)
-        else:
-            x = conv(self.num_filters, (7, 7), (2, 2), padding=_sym_pad(7),
-                     name="conv1")(x)
-        x = norm(name="bn1")(x)
-        x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+                    f"unknown stem {self.stem!r}; 'v1' or 's2d'")
+            if self.stem == "s2d":
+                b, h, w, c = x.shape
+                if h % 2 or w % 2:
+                    raise ValueError(
+                        f"stem='s2d' needs even H/W (space-to-depth "
+                        f"rearrange), got {h}x{w}")
+                x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+                x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+                    b, h // 2, w // 2, 4 * c)
+                # pad (2,1): exact receptive-field match of 7x7/s2 pad 3
+                x = conv(self.num_filters, (4, 4), (1, 1),
+                         padding=((2, 1), (2, 1)), name="conv1")(x)
+            else:
+                x = conv(self.num_filters, (7, 7), (2, 2),
+                         padding=_sym_pad(7), name="conv1")(x)
+            x = norm(name="bn1")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                            padding=((1, 1), (1, 1)))
         block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
-        for i, block_count in enumerate(self.stage_sizes):
-            for j in range(block_count):
+        lo = 0 if self.stage in (None, 0) else self.pipe_boundary
+        hi = (len(self.stage_sizes) if self.stage in (None, 1)
+              else self.pipe_boundary)
+        for i in range(lo, hi):
+            for j in range(self.stage_sizes[i]):
                 strides = 2 if i > 0 and j == 0 else 1
                 x = block_cls(
                     filters=self.num_filters * 2 ** i,
                     conv=conv, norm=norm, strides=strides,
                     name=f"layer{i + 1}_block{j}")(x)
+        if self.stage == 0:
+            return x  # feature map at the pipeline boundary
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = x.astype(jnp.float32)  # classifier head in fp32
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)

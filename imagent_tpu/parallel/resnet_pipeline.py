@@ -1,0 +1,156 @@
+"""GPipe pipeline parallelism for the ResNet family (2 stages).
+
+The ViT pipeline (``parallel/pipeline.py``) exploits a homogeneous
+encoder: stages are a layer-stacked ``nn.scan`` sharded over the pipe
+axis. A ResNet's stages are heterogeneous (different spatial extents
+and channel counts per residual stage), so this module pipelines it
+differently — and TPU-idiomatically — as ONE shard_map program:
+
+* the network is split at a residual-stage boundary into two staged
+  twins of the SAME module (``models/resnet.py`` ``stage=0/1`` — module
+  names are explicit, so each stage consumes the exact subtree of the
+  full parameter tree, which stays REPLICATED over the pipe axis:
+  ResNet pp is an *activation-memory* pipeline, the win at large
+  images/batches, not a parameter shard);
+* the GPipe schedule is one ``lax.scan`` of M+1 ticks; each tick every
+  pipe rank runs its stage under ``lax.switch``/``lax.cond`` predication
+  and hands the boundary feature map forward with a single-hop
+  ``ppermute`` (exactly the ViT pipeline's communication pattern);
+* logits are ``psum``-replicated over the pipe axis, so the standard
+  train step applies unchanged with ``pipe_axis=...`` —
+  ``normalize_region_grads`` pmean's the per-rank partial gradients of
+  the replicated params into the true gradient;
+* BatchNorm: each microbatch normalizes with its OWN batch statistics
+  (identical numerics to ``grad_accum=M`` on one device) and the EMA
+  chains through the scan per stage; the stored stats are
+  ``old + psum(delta over pipe)`` so both stages' updates land.
+
+Eval-mode forward parity vs the unstaged model is exact; train-step
+parity vs a ``grad_accum=M`` reference holds to conv-algorithm noise
+(BN at micro-batch granularity amplifies it — see
+tests/test_resnet_pipeline.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from imagent_tpu.cluster import PIPE_AXIS
+
+
+class PipelinedResNet:
+    """Model-shaped shim (``.apply(variables, x, train, mutable)``)
+    running the 2-stage GPipe schedule; drop-in for
+    ``train.make_train_step(..., pipe_axis=PIPE_AXIS)`` /
+    ``make_eval_step``."""
+
+    def __init__(self, full_model, microbatches: int,
+                 pipe_axis: str = PIPE_AXIS):
+        if microbatches < 1:
+            raise ValueError("microbatches must be >= 1")
+        self.full = full_model
+        self.stage0 = full_model.clone(stage=0)
+        self.stage1 = full_model.clone(stage=1)
+        self.m = microbatches
+        self.axis = pipe_axis
+
+    def _boundary(self, variables, mb: int, x_shape, x_dtype):
+        """Static boundary-activation shape via shape-only evaluation."""
+        out = jax.eval_shape(
+            lambda v, xx: self.stage0.apply(v, xx, train=False),
+            jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(jnp.shape(a), a.dtype),
+                variables),
+            jax.ShapeDtypeStruct((mb,) + tuple(x_shape[1:]), x_dtype))
+        return out.shape, out.dtype
+
+    def apply(self, variables, x, train: bool = True, mutable=None):
+        params = variables["params"]
+        bstats = variables["batch_stats"]
+        m = self.m
+        if x.shape[0] % m:
+            raise ValueError(f"per-device batch {x.shape[0]} not "
+                             f"divisible by --microbatches {m}")
+        mb = x.shape[0] // m
+        xm = x.reshape(m, mb, *x.shape[1:])
+        bshape, bdtype = self._boundary(variables, mb, x.shape, x.dtype)
+        n_cls = self.full.num_classes
+        if lax.psum(1, self.axis) != 2:
+            # The schedule is 2-stage: more pipe ranks would silently
+            # psum garbage logits from idle ranks into the result.
+            raise ValueError("PipelinedResNet requires a pipe axis of "
+                             "exactly 2 (2-stage GPipe)")
+        r = lax.axis_index(self.axis)
+
+        def run_stage(stage, bs, inp):
+            if train:
+                y, mut = stage.apply({"params": params, "batch_stats": bs},
+                                     inp, train=True,
+                                     mutable=["batch_stats"])
+                return y, mut["batch_stats"]
+            return stage.apply({"params": params, "batch_stats": bs},
+                               inp, train=False), bs
+
+        def tick(carry, t):
+            buf, bs, outs = carry
+
+            def rank0(args):
+                buf, bs, outs = args
+
+                def go(bs):
+                    y, bs = run_stage(self.stage0, bs,
+                                      xm[jnp.clip(t, 0, m - 1)])
+                    return y.astype(bdtype), bs
+
+                y, bs = lax.cond(
+                    t < m, go,
+                    lambda bs: (jnp.zeros(bshape, bdtype), bs), bs)
+                return y, bs, outs
+
+            def rank1(args):
+                buf, bs, outs = args
+
+                def go(bs):
+                    y, bs = run_stage(self.stage1, bs, buf)
+                    return y.astype(jnp.float32), bs
+
+                y, bs = lax.cond(
+                    t >= 1, go,  # t scans 0..m, so t>=1 <=> a real micro
+                    lambda bs: (jnp.zeros((mb, n_cls), jnp.float32), bs),
+                    bs)
+                # t=0 writes zeros at index 0, overwritten at t=1.
+                outs = lax.dynamic_update_index_in_dim(
+                    outs, y, jnp.clip(t - 1, 0, m - 1), axis=0)
+                return jnp.zeros(bshape, bdtype), bs, outs
+
+            send, bs, outs = lax.switch(jnp.minimum(r, 1), [rank0, rank1],
+                                        (buf, bs, outs))
+            recv = lax.ppermute(send, self.axis, [(0, 1)])
+            return (recv, bs, outs), None
+
+        carry0 = (jnp.zeros(bshape, bdtype), bstats,
+                  jnp.zeros((m, mb, n_cls), jnp.float32))
+        (_, bs, outs), _ = lax.scan(tick, carry0, jnp.arange(m + 1))
+
+        # Replicate logits over the pipe axis (rank 0 contributes zeros)
+        logits = lax.psum(outs.reshape(m * mb, n_cls), self.axis)
+        if not train and mutable is None:
+            return logits
+        # Stored stats: each rank updated only its stage's subtree;
+        # summing deltas over pipe merges both (untouched leaves = 0).
+        new_bs = jax.tree.map(
+            lambda new, old: old + lax.psum(new - old, self.axis),
+            bs, bstats)
+        if mutable:
+            return logits, {"batch_stats": new_bs}
+        return logits
+
+
+def resnet_pp_param_specs(params):
+    """Replicated param specs (the pipe axis shards ACTIVATIONS, not
+    parameters, for the ResNet family)."""
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(lambda _: P(), params)

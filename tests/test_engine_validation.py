@@ -25,7 +25,7 @@ def _cfg(**kw):
     (dict(tensor_parallel=True), "--tensor-parallel requires"),
     (dict(arch="vit_b16", tensor_parallel=True, seq_parallel="ring",
           model_parallel=2), "pick one"),
-    (dict(pipeline_parallel=2), "--pipeline-parallel requires a ViT"),
+    (dict(pipeline_parallel=4), "ResNet pipeline parallelism is 2-stage"),
     (dict(arch="vit_b16", pipeline_parallel=2, seq_parallel="ring",
           model_parallel=2), "--pipeline-parallel with --seq-parallel"),
     (dict(moe_every=2), "--moe-every requires a ViT"),

@@ -1,0 +1,186 @@
+"""ResNet pipeline parallelism (parallel/resnet_pipeline.py): 2-stage
+GPipe over a (data, pipe) mesh with replicated params.
+
+Eval-mode forward/eval-step parity vs the unstaged model is EXACT (BN
+uses running stats — no per-compilation chaos). Train-step parity is
+against a grad_accum=M single-device reference (identical BN
+micro-batch semantics) with conv-algorithm-noise tolerances: BN at
+micro-batch granularity amplifies ulp-level conv differences between
+differently-compiled programs (see test_zero1/test_fsdp notes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from imagent_tpu.cluster import DATA_AXIS, PIPE_AXIS, make_mesh
+from imagent_tpu.models import create_model
+from imagent_tpu.parallel.resnet_pipeline import (
+    PipelinedResNet, resnet_pp_param_specs,
+)
+from imagent_tpu.train import (
+    create_train_state, make_eval_step, make_optimizer, make_train_step,
+    place_state, replicate_state, shard_batch, state_partition_specs,
+)
+
+CLASSES, SIZE, M = 8, 32, 2
+BATCH = 32  # global; dp = 8/(pp=2) = 4 -> per-device 8, micro-batch 4
+
+
+def _setup():
+    full = create_model("resnet18", num_classes=CLASSES)
+    opt = make_optimizer()
+    host = jax.device_get(
+        create_train_state(full, jax.random.key(0), SIZE, opt))
+    rng = np.random.default_rng(3)
+    images = rng.normal(size=(BATCH, SIZE, SIZE, 3)).astype(np.float32)
+    labels = rng.integers(0, CLASSES, size=(BATCH,)).astype(np.int32)
+    return full, opt, host, images, labels
+
+
+def test_staged_apply_matches_full():
+    """stage=0 -> stage=1 on the SAME full variable tree == stage=None."""
+    full, _, host, images, _ = _setup()
+    v = {"params": host.params, "batch_stats": host.batch_stats}
+    want = full.apply(v, jnp.asarray(images[:4]), train=False)
+    s0 = full.clone(stage=0)
+    s1 = full.clone(stage=1)
+    feat = s0.apply(v, jnp.asarray(images[:4]), train=False)
+    got = s1.apply(v, feat, train=False)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_pipelined_eval_step_exact():
+    full, opt, host, images, labels = _setup()
+    mesh = make_mesh(model_parallel=1, pipeline_parallel=2)
+    mask = np.ones((BATCH,), np.float32)
+
+    mesh1 = make_mesh(model_parallel=1, devices=jax.devices()[:1])
+    g1, l1, m1 = shard_batch(mesh1, images, labels, mask)
+    want = np.asarray(make_eval_step(full, mesh1)(
+        replicate_state(host, mesh1), g1, l1, m1))
+
+    pp = PipelinedResNet(full, microbatches=M)
+    specs = state_partition_specs(host, resnet_pp_param_specs(host.params))
+    state = place_state(host, mesh, specs)
+    gi, gl, gm = shard_batch(mesh, images, labels, mask)
+    got = np.asarray(make_eval_step(pp, mesh, specs)(state, gi, gl, gm))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+def test_pipelined_eval_grads_exact():
+    """The mechanics oracle: gradients through the FULL pipeline
+    machinery (scan + switch/cond predication + ppermute + psum +
+    normalize_region_grads) in eval mode (deterministic BN) must match
+    single-device gradients tightly — this isolates schedule/transpose
+    correctness from train-BN's tiny-micro-batch chaos."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from imagent_tpu.ops import softmax_cross_entropy
+    from imagent_tpu.parallel.pipeline import normalize_region_grads
+
+    full, _, host, images, labels = _setup()
+    params, bstats = host.params, host.batch_stats
+
+    def loss_ref(p):
+        logits = full.apply({"params": p, "batch_stats": bstats},
+                            jnp.asarray(images), train=False)
+        return softmax_cross_entropy(logits, jnp.asarray(labels)).mean()
+
+    g_ref = jax.device_get(jax.grad(loss_ref)(params))
+
+    mesh = make_mesh(model_parallel=1, pipeline_parallel=2)
+    pp = PipelinedResNet(full, microbatches=M)
+    specs_p = resnet_pp_param_specs(params)
+
+    def per_device(p, x, y):
+        def loss_fn(p):
+            logits = pp.apply({"params": p, "batch_stats": bstats}, x,
+                              train=False)
+            return softmax_cross_entropy(logits, y).mean()
+        g = jax.grad(loss_fn)(p)
+        g = jax.tree.map(lambda a: lax.pmean(a, DATA_AXIS), g)
+        return normalize_region_grads(g, specs_p, PIPE_AXIS)
+
+    f = jax.jit(jax.shard_map(
+        per_device, mesh=mesh, in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(), check_vma=False))
+    gi, gl = shard_batch(mesh, images, labels)
+    g_pp = jax.device_get(f(params, gi, gl))
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g_ref)[0],
+            jax.tree_util.tree_flatten_with_path(g_pp)[0]):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-4, atol=1e-6,
+            err_msg=jax.tree_util.keystr(path))
+
+
+def test_pipelined_train_step_matches_grad_accum():
+    """pp=2 over (data=4, pipe=2) == grad_accum=M over (data=4) with NO
+    pipe — the BN-granularity-identical reference (per-replica BN over
+    the same 4 data shards, micro-batches of the same 4 samples)."""
+    full, opt, host, images, labels = _setup()
+    lr = np.float32(0.05)
+
+    mesh_dp = make_mesh(model_parallel=1, devices=jax.devices()[:4])
+    ref_step = make_train_step(full, opt, mesh_dp, grad_accum=M)
+    g1, l1 = shard_batch(mesh_dp, images, labels)
+    ref_state, ref_metrics = ref_step(replicate_state(host, mesh_dp),
+                                      g1, l1, lr)
+
+    mesh = make_mesh(model_parallel=1, pipeline_parallel=2)
+    pp = PipelinedResNet(full, microbatches=M)
+    specs = state_partition_specs(host, resnet_pp_param_specs(host.params))
+    state = place_state(host, mesh, specs)
+    step = make_train_step(pp, opt, mesh, state_specs=specs,
+                           pipe_axis=PIPE_AXIS)
+    gi, gl = shard_batch(mesh, images, labels)
+    new_state, metrics = step(state, gi, gl, lr)
+
+    got_m, want_m = np.asarray(metrics), np.asarray(ref_metrics)
+    np.testing.assert_allclose(got_m[0], want_m[0], rtol=1e-4)
+    np.testing.assert_array_equal(got_m[1:], want_m[1:])
+    # Same BN granularity on both sides; residual tolerance covers
+    # conv-algorithm reassociation between the two compiled programs.
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(
+                jax.device_get(ref_state).params)[0],
+            jax.tree_util.tree_flatten_with_path(
+                jax.device_get(new_state).params)[0]):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-3, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path))
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(
+                jax.device_get(ref_state).batch_stats)[0],
+            jax.tree_util.tree_flatten_with_path(
+                jax.device_get(new_state).batch_stats)[0]):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-3, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path))
+
+
+def test_microbatch_divisibility_validated():
+    full, *_ = _setup()
+    pp = PipelinedResNet(full, microbatches=3)
+    v = {"params": {}, "batch_stats": {}}
+    with pytest.raises(ValueError, match="not divisible"):
+        pp.apply(v, jnp.zeros((8, SIZE, SIZE, 3)), train=False)
+
+
+def test_resnet_pp_e2e_from_cli(tmp_path):
+    """The operator surface: --arch resnet18 --pipeline-parallel 2 runs
+    end-to-end through engine.run (train + masked eval + checkpoint)."""
+    from imagent_tpu.config import Config
+    from imagent_tpu.engine import run
+
+    cfg = Config(arch="resnet18", image_size=16, num_classes=4,
+                 batch_size=4, microbatches=2, pipeline_parallel=2,
+                 epochs=2, lr=0.05, dataset="synthetic",
+                 synthetic_size=64, workers=0, bf16=False, log_every=0,
+                 save_model=True, log_dir=str(tmp_path / "tb"),
+                 ckpt_dir=str(tmp_path / "ck"))
+    result = run(cfg)
+    assert result["best_epoch"] >= 0
+    assert result["final_train"]["n"] > 0
