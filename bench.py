@@ -95,8 +95,12 @@ def measure(arch: str, size: int, per_chip_batch: int,
         "unit": "img/s/chip",
         "tflops_per_chip": round(tflops_chip, 2),
         "chip": kind,
+        "compute_dtype": "bf16" if bf16 else "fp32",
     }
-    if peak is not None:
+    # MFU only against a peak that matches the compute dtype — there is
+    # no per-chip fp32 peak table here, and fp32 achieved FLOPs over the
+    # bf16 peak is not a meaningful utilization figure.
+    if peak is not None and bf16:
         out["mfu_pct"] = round(100.0 * tflops_chip / peak, 2)
         out["chip_peak_bf16_tflops"] = peak
     return out

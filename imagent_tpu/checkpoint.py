@@ -214,26 +214,38 @@ def restore(ckpt_dir: str, name: str,
               flush=True)
         return state, _sidecar_meta(ckpt_dir, name)
 
-    # Metadata unreadable: fall back to probing, current layout first.
-    abstract = {
-        "state": state_abstract,
-        "meta": {k: jax.ShapeDtypeStruct((), dtype)
-                 for k, dtype, _ in _META_FIELDS},
-    }
-    try:
-        tree = ckptr.restore(path, abstract)
-        return (tree["state"],
-                {k: v.item() for k, v in tree["meta"].items()})
-    except Exception as wrapped_err:
+    # Metadata unreadable: fall back to probing. Try the current full
+    # meta set first, then every shorter prefix of _META_FIELDS down to
+    # the original 4-field set (fields are only ever appended) — a
+    # {state, meta} checkpoint written by an older framework version has
+    # fewer meta leaves and fails the full-set probe, which must not be
+    # misreported as a layout/arch mismatch.
+    wrapped_err: Exception | None = None
+    for n_meta in range(len(_META_FIELDS), 3, -1):
+        fields = _META_FIELDS[:n_meta]
+        abstract = {
+            "state": state_abstract,
+            "meta": {k: jax.ShapeDtypeStruct((), dtype)
+                     for k, dtype, _ in fields},
+        }
         try:
-            state = ckptr.restore(path, state_abstract)
-        except Exception:
-            raise RuntimeError(
-                f"checkpoint at {path} matches neither the current "
-                "{state, meta} layout nor the legacy flat-TrainState "
-                "layout — arch/--num-classes/optimizer likely differ "
-                "from the run that wrote it") from wrapped_err
-        print(f"NOTE: restored legacy-layout checkpoint {path} "
-              "(pre-{state,meta} format); re-saving will migrate it",
-              flush=True)
-        return state, _sidecar_meta(ckpt_dir, name)
+            tree = ckptr.restore(path, abstract)
+        except Exception as e:
+            if wrapped_err is None:
+                wrapped_err = e
+            continue
+        meta = {k: default for k, _, default in _META_FIELDS}
+        meta.update({k: v.item() for k, v in tree["meta"].items()})
+        return tree["state"], meta
+    try:
+        state = ckptr.restore(path, state_abstract)
+    except Exception:
+        raise RuntimeError(
+            f"checkpoint at {path} matches neither the current "
+            "{state, meta} layout nor the legacy flat-TrainState "
+            "layout — arch/--num-classes/optimizer likely differ "
+            "from the run that wrote it") from wrapped_err
+    print(f"NOTE: restored legacy-layout checkpoint {path} "
+          "(pre-{state,meta} format); re-saving will migrate it",
+          flush=True)
+    return state, _sidecar_meta(ckpt_dir, name)

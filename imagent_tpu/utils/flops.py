@@ -94,9 +94,12 @@ def vit_forward_flops(image_size: int, patch_size: int, hidden_dim: int,
     """Forward FLOPs per image for models/vit.py: patch embed +
     L x (QKV, QK^T, AV, proj, MLP) + head. Multiply-add = 2 FLOPs."""
     del num_heads  # head split doesn't change the FLOP count
-    n = (image_size // patch_size) ** 2 + (1 if cls_token else 0)
+    n_patches = (image_size // patch_size) ** 2
+    n = n_patches + (1 if cls_token else 0)  # per-layer sequence length
     d, m = hidden_dim, mlp_dim
-    flops = 2 * n * (patch_size * patch_size * 3) * d  # patch embed
+    # Patch embed acts on image patches only; the cls token is a learned
+    # embedding, not a projection (models/vit.py concatenates it after).
+    flops = 2 * n_patches * (patch_size * patch_size * 3) * d
     per_layer = (
         2 * n * d * 3 * d      # QKV projections
         + 2 * n * n * d        # QK^T

@@ -59,6 +59,10 @@ def _masked_crc(data: bytes) -> int:
 
 
 def _varint(n: int) -> bytes:
+    # Negative ints encode as 64-bit two's complement (proto int64
+    # semantics); without the mask the >>7 loop below never terminates.
+    if n < 0:
+        n &= (1 << 64) - 1
     out = bytearray()
     while True:
         b = n & 0x7F

@@ -115,6 +115,37 @@ def test_prior_five_field_meta_layout_restores(tmp_path):
     assert meta["seed"] == -1
 
 
+def test_prior_meta_layout_restores_without_metadata_api(
+        tmp_path, monkeypatch):
+    """ADVICE r2: when the Orbax metadata API is unavailable, the probe
+    fallback must still restore a {state, meta} checkpoint with the
+    older 5-field meta set — not raise the misleading arch-mismatch
+    error after only trying the full 8-field probe."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    state = replicate_state(_tiny_state(), make_mesh(model_parallel=1))
+    path = os.path.abspath(str(tmp_path / "last"))
+    old_meta = {"epoch": np.int64(7), "best_top1": np.float64(55.0),
+                "best_top5": np.float64(80.0), "best_epoch": np.int64(6),
+                "resume_step": np.int64(0)}
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, {"state": state, "meta": old_meta})
+    ckptr.wait_until_finished()
+
+    def _no_metadata(self, *a, **k):
+        raise NotImplementedError("metadata API unavailable")
+
+    monkeypatch.setattr(ocp.StandardCheckpointer, "metadata",
+                        _no_metadata)
+    restored = ckpt_lib.restore(str(tmp_path), "last", state)
+    assert restored is not None
+    _, meta = restored
+    assert meta["epoch"] == 7 and meta["best_top1"] == 55.0
+    assert meta["global_batch"] == 0 and meta["seed"] == -1
+
+
 def test_kill_during_async_save_preserves_previous(tmp_path):
     """Durability under preemption-during-save (found by the round-2
     run-of-record exercise): a process killed while an ASYNC save is in

@@ -138,3 +138,18 @@ def test_readable_by_real_tensorboard(tmp_path):
     got = (value.tensor.float_val[0] if value.tensor.float_val
            else value.simple_value)
     assert abs(got - 0.75) < 1e-6
+
+
+def test_varint_negative_terminates():
+    """ADVICE r2: _varint must not hang on negative ints — they encode
+    as 64-bit two's complement (proto int64 semantics, 10 bytes)."""
+    from imagent_tpu.utils.tb_writer import _varint
+
+    enc = _varint(-1)
+    assert enc == b"\xff" * 9 + b"\x01"
+    # Round-trip through the test reader's varint decode:
+    n, shift = 0, 0
+    for b in enc:
+        n |= (b & 0x7F) << shift
+        shift += 7
+    assert n == (1 << 64) - 1
