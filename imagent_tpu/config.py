@@ -155,7 +155,9 @@ def build_parser() -> argparse.ArgumentParser:
     # Promoted constants.
     p.add_argument("--arch", type=str, default=c.arch,
                    choices=["resnet18", "resnet34", "resnet50",
-                            "resnet101", "resnet152", "vit_b16", "vit_l16",
+                            "resnet101", "resnet152", "resnext50_32x4d",
+                            "resnext101_32x8d", "wide_resnet50_2",
+                            "wide_resnet101_2", "vit_b16", "vit_l16",
                             "vit_h14"])
     p.add_argument("--image-size", type=int, default=c.image_size)
     p.add_argument("--num-classes", type=int, default=c.num_classes)

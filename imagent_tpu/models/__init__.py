@@ -11,16 +11,10 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from imagent_tpu.models.resnet import (  # noqa: F401
-    PARAM_COUNTS, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
+    PARAM_COUNTS, RESNET_REGISTRY,
 )
 
-_REGISTRY = {
-    "resnet18": ResNet18,
-    "resnet34": ResNet34,
-    "resnet50": ResNet50,
-    "resnet101": ResNet101,
-    "resnet152": ResNet152,
-}
+_REGISTRY = RESNET_REGISTRY
 
 
 def available_models() -> list[str]:

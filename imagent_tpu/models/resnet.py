@@ -51,9 +51,14 @@ class BasicBlock(nn.Module):
     norm: ModuleDef
     strides: int = 1
     expansion: int = 1
+    groups: int = 1       # torchvision BasicBlock supports neither knob;
+    base_width: int = 64  # kept for a uniform block signature
 
     @nn.compact
     def __call__(self, x):
+        if self.groups != 1 or self.base_width != 64:
+            raise ValueError("BasicBlock only supports groups=1, "
+                             "base_width=64 (torchvision semantics)")
         residual = x
         y = self.conv(self.filters, (3, 3), (self.strides, self.strides),
                       padding=_sym_pad(3))(x)
@@ -72,22 +77,33 @@ class BasicBlock(nn.Module):
 
 class Bottleneck(nn.Module):
     """1x1 → 3x3(stride) → 1x1 block (resnet50/101/152), torchvision v1.5:
-    the stride sits on the 3x3, not the first 1x1."""
+    the stride sits on the 3x3, not the first 1x1.
+
+    ``groups``/``base_width`` generalize the block exactly as
+    torchvision's does: the inner width is
+    ``int(filters * base_width / 64) * groups`` and the 3x3 is a grouped
+    conv — ResNeXt is (groups=32, base_width=4|8), Wide ResNet is
+    (groups=1, base_width=128). Grouped convs lower to
+    ``feature_group_count`` on XLA:TPU (batched narrower MXU matmuls)."""
 
     filters: int
     conv: ModuleDef
     norm: ModuleDef
     strides: int = 1
     expansion: int = 4
+    groups: int = 1
+    base_width: int = 64
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (1, 1), padding="VALID")(x)
+        width = int(self.filters * self.base_width / 64) * self.groups
+        y = self.conv(width, (1, 1), padding="VALID")(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), (self.strides, self.strides),
-                      padding=_sym_pad(3))(y)
+        y = self.conv(width, (3, 3), (self.strides, self.strides),
+                      padding=_sym_pad(3),
+                      feature_group_count=self.groups)(y)
         y = self.norm()(y)
         y = nn.relu(y)
         y = self.conv(self.filters * self.expansion, (1, 1),
@@ -109,6 +125,8 @@ class ResNet(nn.Module):
     block_cls: Callable
     num_classes: int = 1000
     num_filters: int = 64
+    groups: int = 1       # ResNeXt cardinality (grouped 3x3)
+    base_width: int = 64  # per-group width scale; 128 = Wide ResNet
     dtype: jnp.dtype = jnp.float32
     # Rematerialize each residual block on the backward pass
     # (jax.checkpoint): activations are recomputed instead of stored,
@@ -174,6 +192,7 @@ class ResNet(nn.Module):
                 x = block_cls(
                     filters=self.num_filters * 2 ** i,
                     conv=conv, norm=norm, strides=strides,
+                    groups=self.groups, base_width=self.base_width,
                     name=f"layer{i + 1}_block{j}")(x)
         if self.stage == 0:
             return x  # feature map at the pipeline boundary
@@ -183,18 +202,31 @@ class ResNet(nn.Module):
         return x
 
 
-ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
-ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
-ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck)
-ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=Bottleneck)
-ResNet152 = partial(ResNet, stage_sizes=(3, 8, 36, 3), block_cls=Bottleneck)
+# Per-arch structure: (stage_sizes, bottleneck?, groups, base_width).
+# Single source of truth — the model registry (RESNET_REGISTRY below,
+# re-exported via models/__init__), the FLOP accounting
+# (utils/flops.py), and the torch-checkpoint import (engine.py) all
+# derive from this table; config.py's --arch choices list is the one
+# hand-kept mirror (it must not import jax at parse time).
+ARCH_DEFS = {
+    "resnet18": ((2, 2, 2, 2), False, 1, 64),
+    "resnet34": ((3, 4, 6, 3), False, 1, 64),
+    "resnet50": ((3, 4, 6, 3), True, 1, 64),
+    "resnet101": ((3, 4, 23, 3), True, 1, 64),
+    "resnet152": ((3, 8, 36, 3), True, 1, 64),
+    "resnext50_32x4d": ((3, 4, 6, 3), True, 32, 4),
+    "resnext101_32x8d": ((3, 4, 23, 3), True, 32, 8),
+    "wide_resnet50_2": ((3, 4, 6, 3), True, 1, 128),
+    "wide_resnet101_2": ((3, 4, 23, 3), True, 1, 128),
+}
 
-STAGE_SIZES = {
-    "resnet18": (2, 2, 2, 2),
-    "resnet34": (3, 4, 6, 3),
-    "resnet50": (3, 4, 6, 3),
-    "resnet101": (3, 4, 23, 3),
-    "resnet152": (3, 8, 36, 3),
+STAGE_SIZES = {name: d[0] for name, d in ARCH_DEFS.items()}
+
+RESNET_REGISTRY = {
+    name: partial(ResNet, stage_sizes=stages,
+                  block_cls=Bottleneck if bottleneck else BasicBlock,
+                  groups=groups, base_width=base_width)
+    for name, (stages, bottleneck, groups, base_width) in ARCH_DEFS.items()
 }
 
 # torchvision reference param counts at 1000 classes (trainable params only).
@@ -204,4 +236,8 @@ PARAM_COUNTS = {
     "resnet50": 25_557_032,
     "resnet101": 44_549_160,
     "resnet152": 60_192_808,
+    "resnext50_32x4d": 25_028_904,
+    "resnext101_32x8d": 88_791_336,
+    "wide_resnet50_2": 68_883_240,
+    "wide_resnet101_2": 126_886_696,
 }

@@ -20,7 +20,7 @@ can pass ``remat=True`` to get the executed multiple instead.
 
 from __future__ import annotations
 
-from ..models.resnet import STAGE_SIZES
+from ..models.resnet import ARCH_DEFS, STAGE_SIZES
 
 # bf16 peak TFLOP/s per chip, by `jax.Device.device_kind`.
 # Public numbers: v4 275, v5e ("v5 lite") 197, v5p 459, v6e ("v6 lite",
@@ -53,11 +53,11 @@ def resnet_forward_flops(arch: str, image_size: int,
     """Forward FLOPs per image for the torchvision-plan ResNets
     (models/resnet.py): convs + fc, multiply-add = 2 FLOPs.
 
-    Sanity anchor: resnet50 @ 224 -> 4.09 GMACs (8.18 GFLOPs), the
-    widely published torchvision number.
+    Sanity anchors: resnet50 @ 224 -> 4.09 GMACs (8.18 GFLOPs),
+    resnext50_32x4d -> 4.23, wide_resnet50_2 -> 11.40 — the published
+    torchvision numbers (tests/test_flops.py pins all of them).
     """
-    stages = STAGE_SIZES[arch]
-    bottleneck = arch not in ("resnet18", "resnet34")
+    stages, bottleneck, groups, base_width = ARCH_DEFS[arch]
     flops = 0
     # conv1 7x7/2 pad 3, then 3x3/2 pad 1 maxpool
     h = _conv_out(image_size, 7, 2, 3)
@@ -72,10 +72,13 @@ def resnet_forward_flops(arch: str, image_size: int,
             h_in = h
             h_out = _conv_out(h_in, 3, stride, 1)
             if bottleneck:
-                # 1x1 reduce (full res: stride sits on the 3x3, v1.5)
-                flops += 2 * cin * f * h_in * h_in
-                flops += 2 * 3 * 3 * f * f * h_out * h_out
-                flops += 2 * f * cout * h_out * h_out
+                # 1x1 reduce (full res: stride sits on the 3x3, v1.5);
+                # inner width widened by base_width, 3x3 grouped — each
+                # of the w outputs sees only w/groups inputs.
+                w = int(f * base_width / 64) * groups
+                flops += 2 * cin * w * h_in * h_in
+                flops += 2 * 3 * 3 * (w // groups) * w * h_out * h_out
+                flops += 2 * w * cout * h_out * h_out
             else:
                 flops += 2 * 3 * 3 * cin * f * h_out * h_out
                 flops += 2 * 3 * 3 * f * f * h_out * h_out

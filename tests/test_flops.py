@@ -18,6 +18,10 @@ PUBLISHED_GMACS = {
     "resnet50": 4.089,
     "resnet101": 7.801,
     "resnet152": 11.514,
+    "resnext50_32x4d": 4.230,
+    "resnext101_32x8d": 16.414,
+    "wide_resnet50_2": 11.398,
+    "wide_resnet101_2": 22.753,
 }
 
 

@@ -32,6 +32,30 @@ def test_param_counts_deep(arch, count):
     assert n_params(variables["params"]) == count - 990 * head_in - 990
 
 
+@pytest.mark.parametrize("arch", ["resnext50_32x4d", "resnext101_32x8d",
+                                  "wide_resnet50_2", "wide_resnet101_2"])
+def test_param_counts_resnext_wide(arch):
+    """The groups/base_width generalization pinned to torchvision's
+    published counts (grouped 3x3 kernels are in/groups wide)."""
+    model = create_model(arch, num_classes=10)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 32, 32, 3)), train=False)
+    assert n_params(variables["params"]) == (
+        PARAM_COUNTS[arch] - 990 * 2048 - 990)
+
+
+def test_resnext_forward_runs():
+    model = create_model("resnext50_32x4d", num_classes=10, bf16=True)
+    x = jax.random.normal(jax.random.key(1), (2, 64, 64, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    # grouped 3x3: kernel input dim is width/groups = 128/32
+    k = variables["params"]["layer1_block0"]["Conv_1"]["kernel"]
+    assert k.shape == (3, 3, 4, 128)
+
+
 def test_forward_shapes_and_dtype():
     model = create_model("resnet18", num_classes=1000, bf16=True)
     x = jnp.zeros((2, 64, 64, 3))

@@ -234,6 +234,78 @@ class TorchMiniResNet50(tnn.Module):
         return self.fc(x.mean(dim=(2, 3)))
 
 
+class TorchGroupedBottleneck(tnn.Module):
+    """torchvision bottleneck with cardinality: width =
+    int(planes * base_width / 64) * groups, grouped 3x3 — the
+    ResNeXt/Wide-ResNet block plan."""
+
+    def __init__(self, cin, planes, stride=1, groups=4, base_width=32):
+        super().__init__()
+        cout = planes * 4
+        width = int(planes * base_width / 64) * groups
+        self.conv1 = tnn.Conv2d(cin, width, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(width)
+        self.conv2 = tnn.Conv2d(width, width, 3, stride, 1,
+                                groups=groups, bias=False)
+        self.bn2 = tnn.BatchNorm2d(width)
+        self.conv3 = tnn.Conv2d(width, cout, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        y = self.bn1(self.conv1(x)).relu()
+        y = self.bn2(self.conv2(y)).relu()
+        y = self.bn3(self.conv3(y))
+        return (y + idn).relu()
+
+
+class TorchMiniResNeXt(tnn.Module):
+    def __init__(self, width=8, num_classes=6):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, width, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(width)
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        self.layer1 = tnn.Sequential(TorchGroupedBottleneck(width, width))
+        self.layer2 = tnn.Sequential(
+            TorchGroupedBottleneck(width * 4, width * 2, stride=2))
+        self.fc = tnn.Linear(width * 8, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.bn1(self.conv1(x)).relu())
+        x = self.layer2(self.layer1(x))
+        return self.fc(x.mean(dim=(2, 3)))
+
+
+def test_grouped_bottleneck_logits_match_torch():
+    """Converter + forward parity on the grouped/widened bottleneck
+    (resnext/wide_resnet family): torch's [out, in/groups, kh, kw]
+    grouped kernel must land bit-compatibly in Flax's
+    feature_group_count layout."""
+    from imagent_tpu.models.resnet import Bottleneck, ResNet
+
+    torch.manual_seed(11)
+    tm = TorchMiniResNeXt().eval()
+    with torch.no_grad():
+        _randomize_bn_stats(tm)
+    params, stats = resnet_from_torch(tm.state_dict(), (1, 1))
+    fm = ResNet(stage_sizes=(1, 1), block_cls=Bottleneck, num_classes=6,
+                num_filters=8, groups=4, base_width=32)
+
+    x = np.random.default_rng(6).normal(
+        size=(4, 3, 32, 32)).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x)).numpy()
+    got = np.asarray(fm.apply(
+        {"params": params, "batch_stats": stats},
+        np.transpose(x, (0, 2, 3, 1)), train=False))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
 def test_bottleneck_logits_match_torch():
     """Converter parity on the Bottleneck (resnet50-family) block plan."""
     from imagent_tpu.models.resnet import Bottleneck, ResNet
