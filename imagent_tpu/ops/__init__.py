@@ -1,1 +1,2 @@
 from imagent_tpu.ops.cross_entropy import softmax_cross_entropy  # noqa: F401
+from imagent_tpu.ops.mixing import make_mix_fn  # noqa: F401

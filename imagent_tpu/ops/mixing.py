@@ -1,0 +1,104 @@
+"""In-graph MixUp / CutMix batch augmentation.
+
+The reference trains with no augmentation at all (SURVEY §0 "No data
+augmentation"; ``imagenet.py:280-283`` is Resize+Normalize only) — these
+are the standard modern recipe levers the framework adds on top, done
+the TPU way: the mixing happens INSIDE the jitted train step on the
+device-local batch shard (no host-side RNG, no extra H2D traffic), with
+the PRNG key derived from ``state.step`` so a resumed run replays the
+identical mixing sequence.
+
+Label handling avoids one-hot soft targets entirely: mixing two images
+with weight ``lam`` makes the loss the convex combination
+``lam * CE(logits, y_a) + (1-lam) * CE(logits, y_b)`` — algebraically
+identical to CE against the mixed soft label, but computed from two
+integer gathers (no (B, C) one-hot materialization on the MXU path).
+``train.make_loss_fn`` accepts the resulting ``(y_a, y_b, lam)`` triple.
+
+MixUp: Zhang et al. 2018 (arXiv:1710.09412) — lam ~ Beta(a, a), pixel
+blend with the reversed batch. CutMix: Yun et al. 2019
+(arXiv:1905.04899) — paste a random box from the paired image, lam
+re-adjusted to the exact pasted-pixel ratio. When both are enabled the
+step picks one per batch with a fair coin, timm-style.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pair(images: jnp.ndarray) -> jnp.ndarray:
+    """Mixing partner: the reversed batch. A fixed pairing (vs a sampled
+    permutation) keeps the compiled step free of gather-by-permutation —
+    on TPU a flip is a cheap reverse — and since the loader order is
+    already shuffled per epoch, reversal is as unbiased as a random perm
+    (timm's default mixup does the same)."""
+    return images[::-1]
+
+
+def mixup(key: jax.Array, images: jnp.ndarray, labels: jnp.ndarray,
+          alpha: float):
+    """Blend each image with its reversed-batch partner.
+
+    Returns ``(mixed_images, (y_a, y_b, lam_per_sample))`` where the
+    label triple feeds ``train.make_loss_fn``. One lam for the whole
+    batch (the standard formulation)."""
+    lam = jax.random.beta(key, alpha, alpha)
+    mixed = (lam.astype(images.dtype) * images
+             + (1.0 - lam).astype(images.dtype) * _pair(images))
+    lam_b = jnp.full(labels.shape, lam, jnp.float32)
+    return mixed, (labels, labels[::-1], lam_b)
+
+
+def cutmix(key: jax.Array, images: jnp.ndarray, labels: jnp.ndarray,
+           alpha: float):
+    """Paste a random box from the reversed-batch partner.
+
+    The box has relative area ``1 - lam`` (lam ~ Beta(a, a)), is centered
+    uniformly, and is clipped at the edges; lam is then recomputed from
+    the exact clipped pixel count, so the label weights always match the
+    pixels (the paper's adjustment). Images are NHWC."""
+    k_lam, k_x, k_y = jax.random.split(key, 3)
+    b, h, w, _ = images.shape
+    lam = jax.random.beta(k_lam, alpha, alpha)
+    ratio = jnp.sqrt(1.0 - lam)  # box edge fraction, uniform-ish in area
+    bh, bw = h * ratio, w * ratio
+    cy = jax.random.uniform(k_y, (), minval=0.0, maxval=float(h))
+    cx = jax.random.uniform(k_x, (), minval=0.0, maxval=float(w))
+    y0, y1 = jnp.clip(cy - bh / 2, 0, h), jnp.clip(cy + bh / 2, 0, h)
+    x0, x1 = jnp.clip(cx - bw / 2, 0, w), jnp.clip(cx + bw / 2, 0, w)
+    ys = jnp.arange(h, dtype=jnp.float32)[:, None]
+    xs = jnp.arange(w, dtype=jnp.float32)[None, :]
+    # A pixel row/col is inside when its index sits in [floor(y0), y1).
+    inside = ((ys >= jnp.floor(y0)) & (ys < jnp.floor(y1))
+              & (xs >= jnp.floor(x0)) & (xs < jnp.floor(x1)))
+    mixed = jnp.where(inside[None, :, :, None], _pair(images), images)
+    lam_exact = 1.0 - jnp.sum(inside) / (h * w)
+    lam_b = jnp.full(labels.shape, lam_exact, jnp.float32)
+    return mixed, (labels, labels[::-1], lam_b)
+
+
+def make_mix_fn(mixup_alpha: float = 0.0, cutmix_alpha: float = 0.0):
+    """Build ``mix(key, images, labels) -> (images, labels_or_triple)``
+    for the train step, or None when both alphas are 0 (the compiled
+    step is then bit-identical to the unaugmented one).
+
+    With both enabled, a fair coin per batch picks the mode (timm's
+    ``switch_prob`` default)."""
+    if mixup_alpha <= 0.0 and cutmix_alpha <= 0.0:
+        return None
+
+    def mix(key, images, labels):
+        if mixup_alpha > 0.0 and cutmix_alpha > 0.0:
+            k_switch, k_mix = jax.random.split(key)
+            return lax.cond(
+                jax.random.bernoulli(k_switch),
+                lambda: mixup(k_mix, images, labels, mixup_alpha),
+                lambda: cutmix(k_mix, images, labels, cutmix_alpha))
+        if mixup_alpha > 0.0:
+            return mixup(key, images, labels, mixup_alpha)
+        return cutmix(key, images, labels, cutmix_alpha)
+
+    return mix
