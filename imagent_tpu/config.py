@@ -69,6 +69,14 @@ class Config:
     input_bf16: bool = False
     warmup_epochs: int = 0  # linear LR warmup (0 = reference behavior)
     label_smoothing: float = 0.0  # CE smoothing (0 = reference behavior)
+    # In-graph batch augmentation (ops/mixing.py): Beta(a, a) mixing
+    # strength; 0 = off = reference behavior. Both > 0 = coin flip per
+    # batch between the two modes.
+    mixup: float = 0.0
+    cutmix: float = 0.0
+    # Parameter EMA maintained inside the train step; eval runs on the
+    # averaged weights when > 0 (train.TrainState.ema_params).
+    ema_decay: float = 0.0
     # jax.checkpoint each residual/encoder block: recompute activations
     # on the backward pass — ~33% more FLOPs for O(depth) less HBM.
     remat: bool = False
@@ -197,6 +205,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup-epochs", type=int, default=c.warmup_epochs)
     p.add_argument("--label-smoothing", type=float,
                    default=c.label_smoothing)
+    p.add_argument("--mixup", type=float, default=c.mixup,
+                   help="MixUp Beta(a,a) strength, in-graph (0 = off)")
+    p.add_argument("--cutmix", type=float, default=c.cutmix,
+                   help="CutMix Beta(a,a) strength, in-graph (0 = off)")
+    p.add_argument("--ema-decay", type=float, default=c.ema_decay,
+                   help="parameter EMA decay; eval uses the averaged "
+                        "weights (0 = off)")
     p.add_argument("--remat", action="store_true", default=False,
                    help="rematerialize blocks on backward (less HBM)")
     p.add_argument("--stem", default=c.stem, choices=["v1", "s2d"],

@@ -26,6 +26,7 @@ import signal
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from imagent_tpu import checkpoint as ckpt_lib
@@ -176,7 +177,12 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
 def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
              epoch: int) -> tuple[dict, float]:
     """Validation epoch (reference ``validate()``, ``imagenet.py:166-210``),
-    exact under padding via the mask."""
+    exact under padding via the mask. With --ema-decay the evaluated
+    weights are the EMA (``model.eval()`` on the averaged model); the
+    tree structure is unchanged, so the compiled step and its shardings
+    are reused as-is."""
+    if cfg.ema_decay > 0.0 and state.ema_params is not None:
+        state = state.replace(params=state.ema_params)
     t0 = time.time()
     metric_buf = []
     for images, labels, mask in device_prefetch(
@@ -385,6 +391,11 @@ def run(cfg: Config, stop_check=None) -> dict:
         if is_master:
             print(f"initialized params from torch checkpoint "
                   f"{cfg.init_from_torch}", flush=True)
+    if cfg.ema_decay > 0.0:
+        # Fresh buffers (not aliases) — the train step donates the state,
+        # and a leaf may not be donated through two tree slots at once.
+        state = state.replace(
+            ema_params=jax.tree.map(jnp.array, state.params))
     if cfg.zero1:
         from imagent_tpu.parallel import zero as zero_lib
         state = state.replace(
@@ -419,6 +430,8 @@ def run(cfg: Config, stop_check=None) -> dict:
         state_specs = state_partition_specs(
             state, vit_tp_param_specs(state.params))
     state = place_state(state, mesh, state_specs)
+    from imagent_tpu.ops import make_mix_fn
+    mix_fn = make_mix_fn(cfg.mixup, cfg.cutmix)
     if cfg.fsdp:
         from imagent_tpu.train import (
             make_eval_step_auto, make_train_step_auto,
@@ -427,7 +440,8 @@ def run(cfg: Config, stop_check=None) -> dict:
             model, optimizer, mesh, state_specs,
             label_smoothing=cfg.label_smoothing,
             aux_loss_weight=cfg.moe_aux_weight,
-            grad_accum=cfg.grad_accum)
+            grad_accum=cfg.grad_accum,
+            mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay)
         eval_step = make_eval_step_auto(model, mesh, state_specs)
     else:
         train_step = make_train_step(
@@ -437,7 +451,8 @@ def run(cfg: Config, stop_check=None) -> dict:
             pipe_axis=cluster.PIPE_AXIS if use_pp else None,
             expert_parallel=use_ep, aux_loss_weight=cfg.moe_aux_weight,
             zero1=cfg.zero1, momentum=cfg.momentum,
-            weight_decay=cfg.weight_decay)
+            weight_decay=cfg.weight_decay,
+            mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay)
         eval_step = make_eval_step(model, mesh, state_specs)
 
     start_epoch, best_top1, best_top5, best_epoch = 0, 0.0, 0.0, -1

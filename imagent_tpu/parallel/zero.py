@@ -60,6 +60,8 @@ def zero1_state_specs(state) -> "object":
         params=jax.tree.map(lambda _: P(), state.params),
         batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
         opt_state=P(DATA_AXIS),
+        ema_params=None if state.ema_params is None else
+        jax.tree.map(lambda _: P(), state.ema_params),
     )
 
 

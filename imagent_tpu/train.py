@@ -51,12 +51,18 @@ from imagent_tpu.utils.metrics import topk_correct
 
 class TrainState(flax.struct.PyTreeNode):
     """Replicated training state: the DDP-equivalent bundle of model
-    replica + optimizer slots (``imagenet.py:312-325``)."""
+    replica + optimizer slots (``imagenet.py:312-325``).
+
+    ``ema_params`` (None when --ema-decay is off) is an exponential
+    moving average of ``params`` maintained inside the train step;
+    evaluation runs on it when enabled (engine.py). BatchNorm statistics
+    are not separately averaged — they are already running averages."""
 
     step: jnp.ndarray
     params: Any
     batch_stats: Any
     opt_state: Any
+    ema_params: Any = None
 
 
 def make_optimizer(momentum: float = 0.9,
@@ -152,7 +158,16 @@ def state_partition_specs(state: TrainState, params_specs) -> TrainState:
         params=params_specs,
         batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
         opt_state=opt_specs,
+        # EMA leaves mirror their parameter's layout exactly.
+        ema_params=None if state.ema_params is None else params_specs,
     )
+
+
+def _target_labels(labels) -> jnp.ndarray:
+    """The primary (accuracy-bearing) labels: mixed batches carry a
+    ``(y_a, y_b, lam)`` triple (ops/mixing.py) whose first entry is the
+    original label; plain batches carry the int array itself."""
+    return labels[0] if isinstance(labels, tuple) else labels
 
 
 def make_loss_fn(model, label_smoothing: float = 0.0,
@@ -160,13 +175,26 @@ def make_loss_fn(model, label_smoothing: float = 0.0,
     """The shared training objective: softmax CE (+ any sown aux losses,
     e.g. the MoE load-balancing term) — used by BOTH the explicit
     shard_map step and the FSDP auto step so the semantics can't drift.
-    Returns ``loss, (logits, per_sample, new_batch_stats)``."""
+    Returns ``loss, (logits, per_sample, new_batch_stats)``.
+
+    ``labels`` is either a ``(B,)`` int array or a MixUp/CutMix
+    ``(y_a, y_b, lam)`` triple (ops/mixing.py): the mixed objective is
+    the convex combination of the two hard-label CEs — identical to CE
+    against the mixed soft label, without materializing one-hots."""
 
     def loss_fn(params, batch_stats, images, labels):
         logits, mutated = model.apply(
             {"params": params, "batch_stats": batch_stats},
             images, train=True, mutable=["batch_stats", "intermediates"])
-        per_sample = softmax_cross_entropy(logits, labels, label_smoothing)
+        if isinstance(labels, tuple):
+            y_a, y_b, lam = labels
+            per_sample = (
+                lam * softmax_cross_entropy(logits, y_a, label_smoothing)
+                + (1.0 - lam)
+                * softmax_cross_entropy(logits, y_b, label_smoothing))
+        else:
+            per_sample = softmax_cross_entropy(logits, labels,
+                                               label_smoothing)
         loss = per_sample.mean()
         aux = jax.tree_util.tree_leaves(mutated.get("intermediates", {}))
         if aux:  # static: sown aux losses (MoE load balancing)
@@ -194,12 +222,15 @@ def masked_eval_metrics(logits, labels, mask) -> jnp.ndarray:
 
 
 def _grads_and_metrics(grad_fn, params, batch_stats, images, labels):
-    """One batch: (grads, [loss_sum, top1, top5, n], new_batch_stats)."""
+    """One batch: (grads, [loss_sum, top1, top5, n], new_batch_stats).
+    On mixed batches the loss is the mixed objective; top-k counts
+    against the primary label (the convention for mixup training)."""
     (_, (logits, per_sample, new_bs)), grads = grad_fn(
         params, batch_stats, images, labels)
-    c1, c5 = topk_correct(logits, labels)
+    targets = _target_labels(labels)
+    c1, c5 = topk_correct(logits, targets)
     metrics = jnp.stack([per_sample.sum(), c1, c5,
-                         jnp.float32(labels.shape[0])])
+                         jnp.float32(targets.shape[0])])
     return grads, metrics, new_bs
 
 
@@ -219,6 +250,8 @@ def _scan_microbatches(grad_fn, params, batch_stats, images_k, labels_k,
         grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
         return (bs, grads_acc, metrics_acc + m), None
 
+    # labels_k may be a (y_a, y_b, lam) triple (mixed batch) — scan
+    # slices pytree xs leaf-wise, so micro() sees the per-micro triple.
     zeros = jax.tree.map(jnp.zeros_like, params)
     (new_bs, grads_sum, metrics), _ = lax.scan(
         micro, (batch_stats, zeros, jnp.zeros((4,), jnp.float32)),
@@ -236,7 +269,10 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
                     expert_parallel: bool = False,
                     aux_loss_weight: float = 0.01,
                     zero1: bool = False, momentum: float = 0.9,
-                    weight_decay: float = 1e-4) -> Callable:
+                    weight_decay: float = 1e-4,
+                    mix_fn: Callable | None = None,
+                    mix_seed: int = 0,
+                    ema_decay: float = 0.0) -> Callable:
     """Build the jitted SPMD train step.
 
     ``shard_map`` over the ``data`` axis gives each device its batch shard
@@ -275,6 +311,13 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     torch-order SGD(momentum, weight_decay) runs on each shard's slice —
     numerically identical to the replicated path. ``state.opt_state``
     must be the flat buffer from ``zero.init_opt_state``.
+
+    ``mix_fn`` (ops/mixing.make_mix_fn): MixUp/CutMix applied in-graph
+    to each device's batch shard before the forward pass. The PRNG key
+    is ``fold_in(key(mix_seed), state.step)`` — replicated across
+    devices (every model/pipe shard of the same data rows mixes
+    identically) and a pure function of the step, so preemption+resume
+    replays the identical augmentation sequence.
     """
     if (pipe_axis is not None or expert_parallel) and state_specs is None:
         raise ValueError("pipe_axis / expert_parallel require state_specs "
@@ -295,9 +338,13 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         return _scan_microbatches(
             grad_fn, params, batch_stats,
             images.reshape(grad_accum, -1, *images.shape[1:]),
-            labels.reshape(grad_accum, -1), grad_accum)
+            jax.tree.map(lambda a: a.reshape(grad_accum, -1), labels),
+            grad_accum)
 
     def per_device_step(state: TrainState, images, labels, lr):
+        if mix_fn is not None:
+            key = jax.random.fold_in(jax.random.key(mix_seed), state.step)
+            images, labels = mix_fn(key, images, labels)
         grads, local, new_bs = accumulate(
             state.params, state.batch_stats, images, labels)
 
@@ -329,9 +376,16 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
 
         metrics = lax.psum(local, DATA_AXIS)
 
+        new_ema = state.ema_params
+        if ema_decay > 0.0:  # timm ModelEma semantics: no bias correction
+            new_ema = jax.tree.map(
+                lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
+                state.ema_params, new_params)
+
         new_state = state.replace(
             step=state.step + 1, params=new_params,
-            batch_stats=new_bs, opt_state=new_opt_state)
+            batch_stats=new_bs, opt_state=new_opt_state,
+            ema_params=new_ema)
         return new_state, metrics
 
     st = state_specs if state_specs is not None else P()
@@ -347,7 +401,10 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
                          mesh: Mesh, state_specs: TrainState,
                          label_smoothing: float = 0.0,
                          aux_loss_weight: float = 0.01,
-                         grad_accum: int = 1) -> Callable:
+                         grad_accum: int = 1,
+                         mix_fn: Callable | None = None,
+                         mix_seed: int = 0,
+                         ema_decay: float = 0.0) -> Callable:
     """FSDP train step via the XLA SPMD partitioner (``parallel/fsdp.py``).
 
     A PLAIN jitted function — no ``shard_map``, no axis names. Param and
@@ -388,23 +445,38 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
         # the swap to (K, n, b_loc, ...) then merges back to per-micro
         # global batches (K, n*b_loc, ...) still sharded over `data`.
         im = images.reshape(n_data, grad_accum, b_loc, *images.shape[1:])
-        lb = labels.reshape(n_data, grad_accum, b_loc)
+        lb = jax.tree.map(
+            lambda a: jnp.swapaxes(
+                a.reshape(n_data, grad_accum, b_loc), 0, 1
+            ).reshape(grad_accum, n_data * b_loc), labels)
         im = jnp.swapaxes(im, 0, 1).reshape(
             grad_accum, n_data * b_loc, *images.shape[1:])
-        lb = jnp.swapaxes(lb, 0, 1).reshape(grad_accum, n_data * b_loc)
         return _scan_microbatches(grad_fn, params, batch_stats, im, lb,
                                   grad_accum)
 
     def step(state: TrainState, images, labels, lr):
+        if mix_fn is not None:
+            # Global-batch mixing (the partitioner sees one logical
+            # batch): the reversed-batch pairing spans devices — XLA
+            # inserts the permute — consistent with this path's
+            # global-batch BN/loss semantics.
+            key = jax.random.fold_in(jax.random.key(mix_seed), state.step)
+            images, labels = mix_fn(key, images, labels)
         grads, metrics, new_bs = accumulate_auto(
             state.params, state.batch_stats, images, labels)
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params)
         new_params = optax.apply_updates(
             state.params, jax.tree.map(lambda u: -lr * u, updates))
+        new_ema = state.ema_params
+        if ema_decay > 0.0:
+            new_ema = jax.tree.map(
+                lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
+                state.ema_params, new_params)
         return state.replace(step=state.step + 1, params=new_params,
                              batch_stats=new_bs,
-                             opt_state=new_opt_state), metrics
+                             opt_state=new_opt_state,
+                             ema_params=new_ema), metrics
 
     state_sh = shardings_from_specs(mesh, state_specs)
     batch_sh = NamedSharding(mesh, P(DATA_AXIS))

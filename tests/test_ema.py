@@ -1,0 +1,101 @@
+"""Parameter EMA (train.TrainState.ema_params, --ema-decay).
+
+The reference has no weight averaging; this is the standard recipe
+lever, maintained inside the jitted step so it costs one fused
+multiply-add pass and no extra host traffic.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from imagent_tpu.cluster import make_mesh
+from imagent_tpu.models import create_model
+from imagent_tpu.train import (
+    create_train_state, make_optimizer, make_train_step, replicate_state,
+    shard_batch,
+)
+
+B, SIZE, C = 8, 16, 4
+
+
+def _setup(ema_decay):
+    mesh = make_mesh(model_parallel=1)
+    model = create_model("resnet18", num_classes=C)
+    opt = make_optimizer()
+    state = create_train_state(model, jax.random.key(0), SIZE, opt)
+    if ema_decay > 0.0:
+        import jax.numpy as jnp
+        state = state.replace(
+            ema_params=jax.tree.map(jnp.array, state.params))
+    state = replicate_state(state, mesh)
+    step = make_train_step(model, opt, mesh, ema_decay=ema_decay)
+    rng = np.random.default_rng(1)
+    images = rng.normal(size=(B, SIZE, SIZE, 3)).astype(np.float32)
+    labels = rng.integers(0, C, size=(B,)).astype(np.int32)
+    return mesh, state, step, images, labels
+
+
+def test_ema_update_math():
+    """After one step: ema == d * init + (1-d) * new_params, and the
+    params trajectory is IDENTICAL to a no-EMA run (the average is an
+    observer, never fed back into training)."""
+    d = 0.5
+    mesh, state, step, images, labels = _setup(d)
+    init = jax.device_get(state.params)
+    gi, gl = shard_batch(mesh, images, labels)
+    new_state, _ = step(state, gi, gl, np.float32(0.1))
+
+    mesh2, state2, step2, _, _ = _setup(0.0)
+    assert state2.ema_params is None
+    new_plain, _ = step2(state2, *shard_batch(mesh2, images, labels),
+                         np.float32(0.1))
+
+    got_p = jax.device_get(new_state.params)
+    want_p = jax.device_get(new_plain.params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+                 got_p, want_p)
+    got_ema = jax.device_get(new_state.ema_params)
+    jax.tree.map(
+        lambda e, i, p: np.testing.assert_allclose(
+            e, d * i + (1 - d) * p, rtol=1e-5, atol=1e-7),
+        got_ema, init, got_p)
+    assert jax.device_get(new_plain.ema_params) is None
+
+
+def test_engine_ema_trains_and_resumes(tmp_path):
+    """--ema-decay end-to-end: eval runs on the averaged weights, the
+    EMA rides the checkpoint, and --resume continues it."""
+    from imagent_tpu.config import Config
+    from imagent_tpu.engine import run
+
+    cfg = Config(arch="resnet18", image_size=16, num_classes=4,
+                 batch_size=4, epochs=2, lr=0.05, dataset="synthetic",
+                 synthetic_size=32, workers=0, bf16=False, log_every=0,
+                 ema_decay=0.9, save_model=True,
+                 log_dir=str(tmp_path / "tb"),
+                 ckpt_dir=str(tmp_path / "ckpt"))
+    result = run(cfg)
+    assert np.isfinite(result["final_val"]["loss"])
+
+    resumed = run(cfg.replace(epochs=3, resume=True))
+    assert np.isfinite(resumed["final_val"]["loss"])
+
+
+def test_eval_uses_ema_weights(tmp_path):
+    """The evaluated model is the averaged one: with decay ~1.0 the EMA
+    stays at initialization, so val metrics must differ from a no-EMA
+    twin whose eval tracks the trained weights."""
+    from imagent_tpu.config import Config
+    from imagent_tpu.engine import run
+
+    base = dict(arch="resnet18", image_size=16, num_classes=4,
+                batch_size=8, epochs=2, lr=0.2, dataset="synthetic",
+                synthetic_size=64, workers=0, bf16=False, log_every=0,
+                log_dir=str(tmp_path / "tb1"),
+                ckpt_dir=str(tmp_path / "c1"))
+    frozen = run(Config(**base, ema_decay=0.999999))
+    live = run(Config(**{**base, "log_dir": str(tmp_path / "tb2"),
+                         "ckpt_dir": str(tmp_path / "c2")}))
+    assert frozen["final_val"]["loss"] != pytest.approx(
+        live["final_val"]["loss"], rel=1e-6)
