@@ -1,21 +1,24 @@
 """Headline benchmarks with MFU accounting.
 
-Two configs, every round:
+Three configs, every round:
   1. (primary, parsed) ResNet-18 448x448 b128/chip — mirrors the
      reference's run-of-record (`imagent_sgd.out:14,278`; BASELINE.md:
      152.8 img/s/GPU on its 16-GPU cluster).
   2. ResNet-50 224x224 b256/chip — the north-star config
      (BASELINE.json: >= 1200 img/s/chip).
+  3. ViT-B/16 224x224 b256/chip AdamW — the attention-family headline
+     (no reference analogue; MFU is the scoreboard).
 
-Both measure the jitted SPMD train step on the local device(s) with
+All measure the jitted SPMD train step on the local device(s) with
 synthetic device-resident data (input pipeline excluded; the honest
 end-to-end epoch number lives in benchmarks/e2e_epoch.py). Each metric
 carries `tflops_per_chip` (analytic model FLOPs: 3x forward,
 multiply-add = 2) and `mfu_pct` against the detected chip's bf16 peak —
 so the number is judged against the hardware, not just a 2019 GPU log.
 
-Prints ONE JSON line; the primary metric is the top-level object,
-the second config rides in "extra".
+Prints ONE JSON line; the primary metric is the top-level object, the
+other configs ride in the "extra" list (a config that fails to measure
+is skipped — the primary line must survive it).
 """
 
 import json
@@ -96,6 +99,7 @@ def measure(arch: str, size: int, per_chip_batch: int,
         "tflops_per_chip": round(tflops_chip, 2),
         "chip": kind,
         "compute_dtype": "bf16" if bf16 else "fp32",
+        "optimizer": optimizer,
     }
     # MFU only against a peak that matches the compute dtype — there is
     # no per-chip fp32 peak table here, and fp32 achieved FLOPs over the
@@ -111,11 +115,19 @@ def main() -> int:
     primary["vs_baseline"] = round(
         primary["value"] / BASELINE_IMG_S_PER_CHIP, 3)
 
-    north_star = measure("resnet50", 224, 256)
-    north_star["vs_baseline"] = round(
-        north_star["value"] / NORTH_STAR_IMG_S_PER_CHIP, 3)
+    # A failing secondary config must not take down the whole round's
+    # benchmark record: the primary line prints regardless.
+    primary["extra"] = []
+    try:
+        north_star = measure("resnet50", 224, 256)
+        north_star["vs_baseline"] = round(
+            north_star["value"] / NORTH_STAR_IMG_S_PER_CHIP, 3)
+        primary["extra"].append(north_star)
+        primary["extra"].append(
+            measure("vit_b16", 224, 256, optimizer="adamw"))
+    except Exception as e:  # noqa: BLE001
+        primary["extra_error"] = f"{type(e).__name__}: {e}"[:200]
 
-    primary["extra"] = [north_star]
     print(json.dumps(primary))
     return 0
 
