@@ -139,7 +139,7 @@ def parse_slurm_env(env: Mapping[str, str]) -> SlurmEnv | None:
 
 def initialize(backend: str | None = None,
                env: Mapping[str, str] | None = None,
-               port: int = DEFAULT_COORDINATOR_PORT) -> SlurmEnv | None:
+               port: int | None = None) -> SlurmEnv | None:
     """Initialize the distributed runtime.
 
     Replaces ``imagenet.py:237-273``: under Slurm with >1 task, call
@@ -161,8 +161,14 @@ def initialize(backend: str | None = None,
         # this process (jax.config) and in spawned workers (env var).
         os.environ["JAX_PLATFORMS"] = backend
         jax.config.update("jax_platforms", backend)
-    senv = parse_slurm_env(env if env is not None else os.environ)
+    environ = env if env is not None else os.environ
+    senv = parse_slurm_env(environ)
     if senv is not None and senv.world_size > 1:
+        if port is None:
+            # Two jobs sharing a login host must not collide on the
+            # fixed reference port (MASTER_PORT 29500, imagenet.py:242).
+            port = int(environ.get("IMAGENT_COORDINATOR_PORT",
+                                   DEFAULT_COORDINATOR_PORT))
         jax.distributed.initialize(
             coordinator_address=f"{senv.coordinator}:{port}",
             num_processes=senv.world_size,
