@@ -185,8 +185,20 @@ def restore(ckpt_dir: str, name: str,
               flush=True)
         path = old
     ckptr = ocp.StandardCheckpointer()
-    state_abstract = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), target)
+
+    def _abstract(x):
+        # Carry the target's live sharding into the restore: without it
+        # Orbax falls back to the sharding recorded at save time, which
+        # names devices that may not exist on THIS topology — restoring
+        # an 8-chip checkpoint on a shrunk 2-chip slice must lay the
+        # logical arrays onto the current mesh, not the old one.
+        sharding = getattr(x, "sharding", None)
+        if isinstance(sharding, jax.sharding.Sharding):
+            return jax.ShapeDtypeStruct(np.shape(x), x.dtype,
+                                        sharding=sharding)
+        return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+
+    state_abstract = jax.tree.map(_abstract, target)
 
     ondisk = None
     try:

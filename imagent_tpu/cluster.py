@@ -167,8 +167,14 @@ def initialize(backend: str | None = None,
         if port is None:
             # Two jobs sharing a login host must not collide on the
             # fixed reference port (MASTER_PORT 29500, imagenet.py:242).
-            port = int(environ.get("IMAGENT_COORDINATOR_PORT",
-                                   DEFAULT_COORDINATOR_PORT))
+            raw = environ.get("IMAGENT_COORDINATOR_PORT", "")
+            try:
+                port = (int(raw.strip()) if raw.strip()
+                        else DEFAULT_COORDINATOR_PORT)
+            except ValueError:
+                raise ValueError(
+                    f"IMAGENT_COORDINATOR_PORT={raw!r} is not a port "
+                    "number") from None
         jax.distributed.initialize(
             coordinator_address=f"{senv.coordinator}:{port}",
             num_processes=senv.world_size,
