@@ -302,10 +302,11 @@ def run(cfg: Config, stop_check=None) -> dict:
     if cfg.zero1 and (use_sp or use_tp or use_pp or use_ep):
         raise ValueError("--zero1 currently supports the data-parallel "
                          "path only (parallel/zero.py)")
-    if cfg.fsdp and (use_sp or use_tp or use_pp or use_ep or cfg.zero1):
+    if cfg.fsdp and (use_sp or use_pp or use_ep or cfg.zero1):
         raise ValueError("--fsdp is its own execution path (XLA SPMD "
-                         "partitioner); it does not combine with the "
-                         "shard_map strategies or --zero1")
+                         "partitioner); it combines with "
+                         "--tensor-parallel (2-D FSDP x TP sharding) "
+                         "but not with sp/pp/ep or --zero1")
     if cfg.stem != "v1":
         if cfg.arch.startswith("vit"):
             raise ValueError("--stem applies to the ResNet family only")
@@ -361,7 +362,7 @@ def run(cfg: Config, stop_check=None) -> dict:
         # param tree, parallel/pipeline.py).
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                                   attn_impl=cfg.attn, stacked=True, remat=cfg.remat)
-    elif use_tp:
+    elif use_tp and not cfg.fsdp:
         model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                              attn_impl=cfg.attn, tp_axis=cluster.MODEL_AXIS, remat=cfg.remat)
         # Host-side init uses the unsharded twin; TP consumes slices of
@@ -401,7 +402,13 @@ def run(cfg: Config, stop_check=None) -> dict:
         state = state.replace(
             opt_state=zero_lib.init_opt_state(state.params, n_data))
     state_specs = None
-    if cfg.fsdp:
+    if cfg.fsdp and use_tp:
+        # Hybrid 2-D sharding: TP dims on `model`, FSDP on `data`, both
+        # as pure annotations on the PLAIN model — GSPMD derives the
+        # collectives (parallel/fsdp.py::fsdp_tp_param_specs).
+        from imagent_tpu.parallel.fsdp import fsdp_tp_state_specs
+        state_specs = fsdp_tp_state_specs(state, n_data)
+    elif cfg.fsdp:
         from imagent_tpu.parallel.fsdp import fsdp_state_specs
         state_specs = fsdp_state_specs(state, n_data)
     elif cfg.zero1:

@@ -32,20 +32,24 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from imagent_tpu.cluster import DATA_AXIS
+from imagent_tpu.cluster import DATA_AXIS, MODEL_AXIS
 
 
-def fsdp_leaf_spec(shape, n_data: int, axis: str = DATA_AXIS) -> P:
-    """Spec for one leaf: biggest dim divisible by ``n_data`` shards."""
+def fsdp_leaf_spec(shape, n_data: int, axis: str = DATA_AXIS,
+                   base: P | None = None) -> P:
+    """Spec for one leaf: biggest dim divisible by ``n_data`` shards.
+    ``base`` (e.g. a TP spec) pins dims already claimed by another axis;
+    the data axis goes on the biggest eligible FREE dim."""
     if not shape:
-        return P()
-    order = sorted(range(len(shape)), key=lambda i: -shape[i])
-    for i in order:
+        return base if base is not None else P()
+    spec = list(tuple(base) + (None,) * (len(shape) - len(base))
+                if base is not None else (None,) * len(shape))
+    free = [i for i in range(len(shape)) if spec[i] is None]
+    for i in sorted(free, key=lambda i: -shape[i]):
         if shape[i] % n_data == 0 and shape[i] >= n_data:
-            spec = [None] * len(shape)
             spec[i] = axis
             return P(*spec)
-    return P()
+    return base if base is not None else P()
 
 
 def fsdp_param_specs(params, n_data: int, axis: str = DATA_AXIS):
@@ -63,6 +67,37 @@ def fsdp_state_specs(state, n_data: int):
     from imagent_tpu.train import state_partition_specs
     return state_partition_specs(
         state, fsdp_param_specs(state.params, n_data))
+
+
+def fsdp_tp_param_specs(params, n_data: int,
+                        data_axis: str = DATA_AXIS,
+                        model_axis: str = MODEL_AXIS):
+    """2-D GSPMD sharding: Megatron-style tensor parallelism AND FSDP on
+    the SAME param tree, expressed purely as sharding annotations.
+
+    Each ViT attention/MLP leaf first gets its TP dim (heads / mlp
+    width) on the ``model`` axis (``vit_tp_param_specs`` — the same
+    layout the explicit shard_map TP uses), then the largest remaining
+    dim divisible by ``n_data`` shards over ``data``. TP-replicated
+    leaves (LayerNorm, embeddings, head) shard over ``data`` only. The
+    XLA SPMD partitioner then derives BOTH collective families from the
+    annotations: per-layer all-gathers over ``data`` (FSDP) and the
+    activation psums over ``model`` (TP) — no shard_map, no axis names
+    in the model code."""
+    from imagent_tpu.parallel.tensor_parallel import vit_tp_param_specs
+
+    tp = vit_tp_param_specs(params, axis=model_axis)
+    return jax.tree.map(
+        lambda leaf, spec: fsdp_leaf_spec(jnp.shape(leaf), n_data,
+                                          data_axis, base=spec),
+        params, tp)
+
+
+def fsdp_tp_state_specs(state, n_data: int):
+    """TrainState-shaped spec tree for the hybrid FSDP x TP layout."""
+    from imagent_tpu.train import state_partition_specs
+    return state_partition_specs(
+        state, fsdp_tp_param_specs(state.params, n_data))
 
 
 def shardings_from_specs(mesh: Mesh, specs):
