@@ -173,7 +173,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=c.momentum)
     p.add_argument("--weight-decay", type=float, default=c.weight_decay)
     p.add_argument("--optimizer", type=str, default=c.optimizer,
-                   choices=["sgd", "nadam", "adamw", "lars"])
+                   choices=["sgd", "nadam", "adamw", "lars", "lamb"])
     p.add_argument("--lr-decay-period", type=int, default=c.lr_decay_period)
     p.add_argument("--lr-decay-factor", type=float, default=c.lr_decay_factor)
     p.add_argument("--workers", type=int, default=c.workers)

@@ -108,8 +108,15 @@ def make_optimizer(momentum: float = 0.9,
                        momentum=momentum),
             optax.scale(-1.0),
         )
+    if name == "lamb":
+        # Layerwise trust ratio over Adam (You et al. 2020) — the
+        # large-batch companion to lars; same sign-flip wiring.
+        return optax.chain(
+            optax.lamb(learning_rate=1.0, weight_decay=weight_decay),
+            optax.scale(-1.0),
+        )
     raise ValueError(f"unknown optimizer {name!r}; "
-                     "one of sgd|nadam|adamw|lars")
+                     "one of sgd|nadam|adamw|lars|lamb")
 
 
 def create_train_state(model, rng: jax.Array, image_size: int,

@@ -22,7 +22,7 @@ TINY = dict(patch_size=8, hidden_dim=32, num_layers=2, num_heads=4,
 SIZE = 32
 
 
-@pytest.mark.parametrize("name", ["sgd", "nadam", "adamw", "lars"])
+@pytest.mark.parametrize("name", ["sgd", "nadam", "adamw", "lars", "lamb"])
 def test_optimizer_step_decreases_loss(name):
     mesh = make_mesh(model_parallel=1, devices=jax.devices()[:1])
     model = create_model("resnet18", num_classes=4)
