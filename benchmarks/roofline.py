@@ -7,9 +7,10 @@ differenced to cancel fixed overheads):
   1. achieved HBM bandwidth (bf16 copy-scale chain),
   2. achieved MXU throughput (chained 4096^2 bf16 matmuls),
   3. train-step phase times (full step / fwd train / fwd eval) for the
-     two headline configs, against their analytic MXU + HBM bounds.
+     three headline configs (resnet50@224, resnet18@448, vit_b16@224),
+     against their analytic MXU + HBM bounds.
 
-    python benchmarks/roofline.py            # all sections, ~6 min
+    python benchmarks/roofline.py            # all sections, ~10 min
 """
 
 from __future__ import annotations
@@ -146,7 +147,8 @@ def main() -> int:
     print(json.dumps({"hbm_copy_gbs": round(hbm, 1),
                       "mxu_matmul_tflops": round(mxu, 1)}))
     for arch, size, batch in (("resnet50", 224, 256),
-                              ("resnet18", 448, 128)):
+                              ("resnet18", 448, 128),
+                              ("vit_b16", 224, 256)):
         r = measure_step_phases(arch, size, batch)
         r.update({"arch": arch, "image_size": size, "per_chip_batch": batch,
                   "img_s": round(batch / (r["step_ms"] / 1e3), 1)})

@@ -1,3 +1,3 @@
 from imagent_tpu.compat.torch_weights import (  # noqa: F401
-    resnet_from_torch, vit_from_torch,
+    resnet_from_torch, resnet_to_torch, vit_from_torch,
 )

@@ -138,3 +138,58 @@ def vit_from_torch(state_dict: dict, num_heads: int) -> dict:
         }
         i += 1
     return params
+
+
+def _conv_inv(k) -> np.ndarray:
+    return np.transpose(np.asarray(k), (3, 2, 0, 1))  # HWIO -> OIHW
+
+
+def _linear_inv(k) -> np.ndarray:
+    return np.transpose(np.asarray(k), (1, 0))  # [in,out] -> [out,in]
+
+
+def resnet_to_torch(params: dict, batch_stats: dict,
+                    stage_sizes) -> dict:
+    """The inverse of ``resnet_from_torch``: our param/batch_stats trees
+    → a torchvision-named ResNet ``state_dict`` (numpy values; pass
+    through ``torch.from_numpy``/``torch.save`` as desired).
+
+    Gives reference users a two-way street: train here, keep serving or
+    analyzing with their existing torch tooling. ``num_batches_tracked``
+    is emitted as 0 (our BN momentum is torch-equivalent but we don't
+    count batches; torchvision loads fine either way). Round-trip is
+    bit-exact (tests/test_torch_compat.py)."""
+    stats = batch_stats
+    sd: dict = {}
+
+    def put_bn(dst: str, p: dict, s: dict):
+        sd[f"{dst}.weight"] = np.asarray(p["scale"])
+        sd[f"{dst}.bias"] = np.asarray(p["bias"])
+        sd[f"{dst}.running_mean"] = np.asarray(s["mean"])
+        sd[f"{dst}.running_var"] = np.asarray(s["var"])
+        sd[f"{dst}.num_batches_tracked"] = np.asarray(0, np.int64)
+
+    sd["conv1.weight"] = _conv_inv(params["conv1"]["kernel"])
+    put_bn("bn1", params["bn1"], stats["bn1"])
+
+    for i, n_blocks in enumerate(stage_sizes):
+        for j in range(n_blocks):
+            name = f"layer{i + 1}_block{j}"
+            dst = f"layer{i + 1}.{j}"
+            p, s = params[name], stats[name]
+            k = 0
+            while f"Conv_{k}" in p:
+                sd[f"{dst}.conv{k + 1}.weight"] = _conv_inv(
+                    p[f"Conv_{k}"]["kernel"])
+                put_bn(f"{dst}.bn{k + 1}", p[f"BatchNorm_{k}"],
+                       s[f"BatchNorm_{k}"])
+                k += 1
+            if "downsample_conv" in p:
+                sd[f"{dst}.downsample.0.weight"] = _conv_inv(
+                    p["downsample_conv"]["kernel"])
+                put_bn(f"{dst}.downsample.1", p["downsample_bn"],
+                       s["downsample_bn"])
+
+    sd["fc.weight"] = _linear_inv(params["fc"]["kernel"])
+    sd["fc.bias"] = np.asarray(params["fc"]["bias"])
+    return sd
