@@ -107,9 +107,13 @@ class Config:
     # Megatron-style tensor parallelism over the model axis (ViT only):
     # heads + MLP hidden shard across chips (parallel/tensor_parallel.py).
     tensor_parallel: bool = False
-    # GPipe pipeline parallelism over the pipe axis (ViT only): encoder
-    # layers split into stages, microbatches streamed via ppermute
-    # (parallel/pipeline.py). Composes with --tensor-parallel (3-D mesh).
+    # GPipe pipeline parallelism over the pipe axis: ViT encoder layers
+    # split into stages (any S), or the ResNet conv stages (S=2),
+    # microbatches streamed via ppermute (parallel/pipeline.py,
+    # parallel/resnet_pipeline.py). On ViT composes with
+    # --tensor-parallel,
+    # --seq-parallel ring|ulysses, and (at --moe-every 1)
+    # --expert-parallel — 3-D mesh in every case.
     pipeline_parallel: int = 1
     microbatches: int = 1  # GPipe microbatches per step (pipeline path)
     # Mixture-of-Experts (ViT only): every k-th block's MLP becomes a

@@ -281,9 +281,9 @@ def run(cfg: Config, stop_check=None) -> dict:
         raise ValueError("ResNet pipeline parallelism is 2-stage "
                          "(--pipeline-parallel 2); deeper conv-stage "
                          "pipelines need a ViT arch")
-    if use_pp and use_sp:
-        raise ValueError("--pipeline-parallel with --seq-parallel is not "
-                         "supported; compose pp with --tensor-parallel")
+    # pp x sp composes: stages shard layers over `pipe` while ring /
+    # Ulysses attention shards tokens over `model` inside each stage
+    # (exactness-tested in tests/test_pp_sp.py).
     use_ep = cfg.expert_parallel
     if cfg.moe_every and not cfg.arch.startswith("vit"):
         raise ValueError("--moe-every requires a ViT arch")
@@ -322,12 +322,18 @@ def run(cfg: Config, stop_check=None) -> dict:
         skip_train=cfg.eval_only)
 
     if use_sp:
+        # Optionally pipelined: layers shard over `pipe`, tokens over
+        # `model` — the ring/Ulysses collectives run inside each stage.
+        pp_kw = (dict(pipe_axis=cluster.PIPE_AXIS,
+                      microbatches=cfg.microbatches) if use_pp else {})
         model = create_model(
             cfg.arch, cfg.num_classes, cfg.bf16, gap_readout=True,
-            attn_impl=cfg.seq_parallel, seq_axis=cluster.MODEL_AXIS, remat=cfg.remat)
+            attn_impl=cfg.seq_parallel, seq_axis=cluster.MODEL_AXIS,
+            remat=cfg.remat, **pp_kw)
         # Same param tree, no mesh-axis ops — usable for host-side init.
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                                  gap_readout=True, remat=cfg.remat)
+                                  gap_readout=True, remat=cfg.remat,
+                                  **({"stacked": True} if use_pp else {}))
     elif cfg.moe_every:
         moe_kw = dict(moe_every=cfg.moe_every, num_experts=cfg.num_experts,
                       capacity_factor=cfg.capacity_factor,

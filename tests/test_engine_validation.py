@@ -26,8 +26,6 @@ def _cfg(**kw):
     (dict(arch="vit_b16", tensor_parallel=True, seq_parallel="ring",
           model_parallel=2), "pick one"),
     (dict(pipeline_parallel=4), "ResNet pipeline parallelism is 2-stage"),
-    (dict(arch="vit_b16", pipeline_parallel=2, seq_parallel="ring",
-          model_parallel=2), "--pipeline-parallel with --seq-parallel"),
     (dict(moe_every=2), "--moe-every requires a ViT"),
     (dict(arch="vit_b16", moe_every=2, tensor_parallel=True,
           model_parallel=2), "MoE composes"),
