@@ -77,6 +77,10 @@ class Config:
     # Parameter EMA maintained inside the train step; eval runs on the
     # averaged weights when > 0 (train.TrainState.ema_params).
     ema_decay: float = 0.0
+    # In-graph photometric jitter (ops/jitter.py): brightness /
+    # contrast / saturation strengths, torchvision factor semantics.
+    # All 0 = off = reference behavior.
+    color_jitter: Sequence[float] = (0.0, 0.0, 0.0)
     # jax.checkpoint each residual/encoder block: recompute activations
     # on the backward pass — ~33% more FLOPs for O(depth) less HBM.
     remat: bool = False
@@ -216,6 +220,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ema-decay", type=float, default=c.ema_decay,
                    help="parameter EMA decay; eval uses the averaged "
                         "weights (0 = off)")
+    p.add_argument("--color-jitter", type=float, nargs=3,
+                   default=list(c.color_jitter),
+                   metavar=("BRIGHTNESS", "CONTRAST", "SATURATION"),
+                   help="in-graph photometric jitter strengths "
+                        "(torchvision semantics; 0 0 0 = off)")
     p.add_argument("--remat", action="store_true", default=False,
                    help="rematerialize blocks on backward (less HBM)")
     p.add_argument("--stem", default=c.stem, choices=["v1", "s2d"],

@@ -257,6 +257,10 @@ def run(cfg: Config, stop_check=None) -> dict:
                  if cfg.grad_accum > 1 else ""),
               flush=True)
 
+    if len(cfg.color_jitter) != 3 or min(cfg.color_jitter) < 0.0:
+        raise ValueError(
+            "--color-jitter takes three non-negative strengths "
+            f"(brightness contrast saturation), got {cfg.color_jitter}")
     use_sp = cfg.seq_parallel != "none"
     if use_sp and (not cfg.arch.startswith("vit") or cfg.model_parallel < 2):
         raise ValueError(
@@ -444,7 +448,10 @@ def run(cfg: Config, stop_check=None) -> dict:
             state, vit_tp_param_specs(state.params))
     state = place_state(state, mesh, state_specs)
     from imagent_tpu.ops import make_mix_fn
+    from imagent_tpu.ops.jitter import make_jitter_fn
     mix_fn = make_mix_fn(cfg.mixup, cfg.cutmix)
+    jitter_fn = make_jitter_fn(*cfg.color_jitter, mean=cfg.mean,
+                               std=cfg.std)
     if cfg.fsdp:
         from imagent_tpu.train import (
             make_eval_step_auto, make_train_step_auto,
@@ -454,7 +461,8 @@ def run(cfg: Config, stop_check=None) -> dict:
             label_smoothing=cfg.label_smoothing,
             aux_loss_weight=cfg.moe_aux_weight,
             grad_accum=cfg.grad_accum,
-            mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay)
+            mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay,
+            jitter_fn=jitter_fn)
         eval_step = make_eval_step_auto(model, mesh, state_specs)
     else:
         train_step = make_train_step(
@@ -465,7 +473,8 @@ def run(cfg: Config, stop_check=None) -> dict:
             expert_parallel=use_ep, aux_loss_weight=cfg.moe_aux_weight,
             zero1=cfg.zero1, momentum=cfg.momentum,
             weight_decay=cfg.weight_decay,
-            mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay)
+            mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay,
+            jitter_fn=jitter_fn)
         eval_step = make_eval_step(model, mesh, state_specs)
 
     start_epoch, best_top1, best_top5, best_epoch = 0, 0.0, 0.0, -1

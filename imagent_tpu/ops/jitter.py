@@ -1,0 +1,87 @@
+"""In-graph color jitter (brightness / contrast / saturation).
+
+torchvision's ``ColorJitter`` runs on host CPU before normalization;
+here the jitter runs INSIDE the jitted train step (keyed off
+``state.step`` like ops/mixing.py, so a resumed run replays the same
+draws and the host pipeline stays byte-identical across decode paths).
+The step receives NORMALIZED images, so the op un-normalizes with the
+run's (mean, std), jitters in RGB space with exact torchvision factor
+semantics, and re-normalizes — all fused by XLA into a few elementwise
+passes, zero host work.
+
+Factor semantics (torchvision ColorJitter):
+  brightness: x * f,              f ~ U[max(0, 1-b), 1+b]
+  contrast:   blend(gray_mean(x), x, f),  f ~ U[max(0, 1-c), 1+c]
+  saturation: blend(gray(x), x, f),       f ~ U[max(0, 1-s), 1+s]
+applied per-image in the fixed order brightness → contrast →
+saturation (torchvision shuffles the order per draw; a fixed order is
+one fewer transcendental-free difference to explain and statistically
+indistinguishable for training). Hue is deliberately absent: the
+HSV round-trip is the one genuinely expensive piece, and the
+reference recipe never used it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Rec.601 luma weights — torchvision's rgb_to_grayscale.
+_LUMA = (0.299, 0.587, 0.114)
+
+
+def _factor(key: jax.Array, strength: float, batch: int) -> jnp.ndarray:
+    lo = max(0.0, 1.0 - strength)
+    return jax.random.uniform(key, (batch, 1, 1, 1),
+                              minval=lo, maxval=1.0 + strength)
+
+
+def color_jitter(key: jax.Array, images: jnp.ndarray,
+                 brightness: float, contrast: float, saturation: float,
+                 mean, std) -> jnp.ndarray:
+    """Jitter a normalized NHWC batch; returns the re-normalized batch
+    in the input dtype."""
+    dtype = images.dtype
+    m = jnp.asarray(mean, jnp.float32).reshape(1, 1, 1, 3)
+    s = jnp.asarray(std, jnp.float32).reshape(1, 1, 1, 3)
+    x = images.astype(jnp.float32) * s + m  # back to [0, 1] RGB
+    b = x.shape[0]
+    k_b, k_c, k_s = jax.random.split(key, 3)
+    # torchvision clamps after EVERY adjust_* (each blend ends in
+    # clamp(0,1)), so later anchors see in-range values — matching that
+    # exactly keeps the "torchvision factor semantics" claim true; the
+    # extra clips fuse into the same elementwise pass.
+    if brightness > 0.0:
+        x = jnp.clip(x * _factor(k_b, brightness, b), 0.0, 1.0)
+    if contrast > 0.0:
+        # torchvision: blend against the MEAN of the grayscale image.
+        gray = jnp.tensordot(x, jnp.asarray(_LUMA, jnp.float32),
+                             axes=[[3], [0]])
+        anchor = gray.mean(axis=(1, 2), keepdims=True)[..., None]
+        f = _factor(k_c, contrast, b)
+        x = jnp.clip(anchor + (x - anchor) * f, 0.0, 1.0)
+    if saturation > 0.0:
+        gray = jnp.tensordot(x, jnp.asarray(_LUMA, jnp.float32),
+                             axes=[[3], [0]])[..., None]
+        f = _factor(k_s, saturation, b)
+        x = jnp.clip(gray + (x - gray) * f, 0.0, 1.0)
+    return ((x - m) / s).astype(dtype)
+
+
+def make_jitter_fn(brightness: float = 0.0, contrast: float = 0.0,
+                   saturation: float = 0.0, mean=(0.5, 0.5, 0.5),
+                   std=(0.5, 0.5, 0.5)):
+    """``jit(key, images) -> images`` for the train step, or None when
+    all strengths are 0 (the compiled step is unchanged)."""
+    if min(brightness, contrast, saturation) < 0.0:
+        raise ValueError(
+            f"color jitter strengths must be >= 0, got "
+            f"({brightness}, {contrast}, {saturation})")
+    if brightness == 0.0 and contrast == 0.0 and saturation == 0.0:
+        return None
+
+    def apply(key, images):
+        return color_jitter(key, images, brightness, contrast,
+                            saturation, mean, std)
+
+    return apply

@@ -279,7 +279,8 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
                     weight_decay: float = 1e-4,
                     mix_fn: Callable | None = None,
                     mix_seed: int = 0,
-                    ema_decay: float = 0.0) -> Callable:
+                    ema_decay: float = 0.0,
+                    jitter_fn: Callable | None = None) -> Callable:
     """Build the jitted SPMD train step.
 
     ``shard_map`` over the ``data`` axis gives each device its batch shard
@@ -349,9 +350,26 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
             grad_accum)
 
     def per_device_step(state: TrainState, images, labels, lr):
-        if mix_fn is not None:
+        if jitter_fn is not None or mix_fn is not None:
             key = jax.random.fold_in(jax.random.key(mix_seed), state.step)
-            images, labels = mix_fn(key, images, labels)
+            if jitter_fn is not None:  # ops/jitter.py, before mixing —
+                # torchvision order: photometric jitter on each source
+                # image, then the batch-level mix. Jitter factors are
+                # PER-IMAGE, so decorrelate across data shards (fold in
+                # the data position; model/pipe shards of the same rows
+                # still agree) — unlike the mix, whose lam is per-batch
+                # by design and stays replicated.
+                jkey = jax.random.fold_in(
+                    jax.random.fold_in(key, 1),
+                    lax.axis_index(DATA_AXIS))
+                images = jitter_fn(jkey, images)
+            if mix_fn is not None:
+                # Key layout note: with jitter off this is the same key
+                # round-2 runs used — their checkpoints resume with the
+                # identical mixing replay.
+                mkey = (key if jitter_fn is None
+                        else jax.random.fold_in(key, 2))
+                images, labels = mix_fn(mkey, images, labels)
         grads, local, new_bs = accumulate(
             state.params, state.batch_stats, images, labels)
 
@@ -411,7 +429,8 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
                          grad_accum: int = 1,
                          mix_fn: Callable | None = None,
                          mix_seed: int = 0,
-                         ema_decay: float = 0.0) -> Callable:
+                         ema_decay: float = 0.0,
+                         jitter_fn: Callable | None = None) -> Callable:
     """FSDP train step via the XLA SPMD partitioner (``parallel/fsdp.py``).
 
     A PLAIN jitted function — no ``shard_map``, no axis names. Param and
@@ -462,13 +481,20 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
                                   grad_accum)
 
     def step(state: TrainState, images, labels, lr):
-        if mix_fn is not None:
+        if jitter_fn is not None or mix_fn is not None:
             # Global-batch mixing (the partitioner sees one logical
             # batch): the reversed-batch pairing spans devices — XLA
             # inserts the permute — consistent with this path's
-            # global-batch BN/loss semantics.
+            # global-batch BN/loss semantics. Jitter draws per-image
+            # factors over the global batch in one shot (no per-shard
+            # decorrelation needed here).
             key = jax.random.fold_in(jax.random.key(mix_seed), state.step)
-            images, labels = mix_fn(key, images, labels)
+            if jitter_fn is not None:
+                images = jitter_fn(jax.random.fold_in(key, 1), images)
+            if mix_fn is not None:
+                mkey = (key if jitter_fn is None
+                        else jax.random.fold_in(key, 2))
+                images, labels = mix_fn(mkey, images, labels)
         grads, metrics, new_bs = accumulate_auto(
             state.params, state.batch_stats, images, labels)
         updates, new_opt_state = optimizer.update(

@@ -18,6 +18,8 @@ def _cfg(**kw):
 
 @pytest.mark.parametrize("kw,match", [
     (dict(grad_accum=0), "--grad-accum"),
+    (dict(color_jitter=(0.4, -0.1, 0.2)), "--color-jitter"),
+    (dict(color_jitter=(0.4, 0.4)), "--color-jitter"),
     (dict(seq_parallel="ring"), "--seq-parallel requires"),
     (dict(attn="flash"), "--attn.*requires a ViT"),
     (dict(arch="vit_b16", attn="flash", seq_parallel="ring",

@@ -1,0 +1,97 @@
+"""In-graph color jitter (ops/jitter.py): torchvision factor semantics
+on normalized batches (un-normalize → jitter → re-normalize in-graph).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from imagent_tpu.ops.jitter import color_jitter, make_jitter_fn
+
+MEAN = STD = (0.5, 0.5, 0.5)
+B, H, W = 4, 8, 8
+
+
+def _norm(x):
+    return (x - 0.5) / 0.5
+
+
+def _unnorm(y):
+    return np.asarray(y) * 0.5 + 0.5
+
+
+def _batch(lo=0.2, hi=0.6, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(lo, hi, size=(B, H, W, 3)).astype(np.float32)
+
+
+def test_zero_strength_is_identity():
+    x = _batch()
+    y = color_jitter(jax.random.key(0), jnp.asarray(_norm(x)),
+                     0.0, 0.0, 0.0, MEAN, STD)
+    np.testing.assert_allclose(np.asarray(y), _norm(x), atol=1e-6)
+    assert make_jitter_fn(0.0, 0.0, 0.0) is None
+
+
+def test_brightness_factor_semantics():
+    """Brightness multiplies each image by one factor in [1-b, 1+b]."""
+    x = _batch()  # values <= 0.6, b=0.3 -> max 0.78, no clipping
+    y = _unnorm(color_jitter(jax.random.key(1), jnp.asarray(_norm(x)),
+                             0.3, 0.0, 0.0, MEAN, STD))
+    ratios = y / x
+    for i in range(B):
+        f = ratios[i].mean()
+        assert 0.7 - 1e-4 <= f <= 1.3 + 1e-4
+        np.testing.assert_allclose(ratios[i], f, rtol=1e-4)
+    # and the per-image factors differ (per-image draws)
+    assert np.std([ratios[i].mean() for i in range(B)]) > 1e-3
+
+
+def test_contrast_preserves_constant_images():
+    """A constant image IS its own gray-mean anchor: contrast no-op."""
+    x = np.full((B, H, W, 3), 0.4, np.float32)
+    y = _unnorm(color_jitter(jax.random.key(2), jnp.asarray(_norm(x)),
+                             0.0, 0.9, 0.0, MEAN, STD))
+    np.testing.assert_allclose(y, x, atol=1e-5)
+
+
+def test_saturation_preserves_gray_images():
+    """R=G=B images equal their grayscale: saturation no-op."""
+    g = _batch()[..., :1]
+    x = np.repeat(g, 3, axis=-1)
+    y = _unnorm(color_jitter(jax.random.key(3), jnp.asarray(_norm(x)),
+                             0.0, 0.0, 0.9, MEAN, STD))
+    np.testing.assert_allclose(y, x, atol=1e-5)
+
+
+def test_output_clamped_to_image_range():
+    x = _batch(0.7, 1.0)  # bright inputs, strong brightness -> clips
+    y = _unnorm(color_jitter(jax.random.key(4), jnp.asarray(_norm(x)),
+                             0.9, 0.0, 0.0, MEAN, STD))
+    assert y.max() <= 1.0 + 1e-6 and y.min() >= -1e-6
+
+
+def test_jitter_deterministic_and_dtype_preserving():
+    x = jnp.asarray(_norm(_batch())).astype(jnp.bfloat16)
+    f = make_jitter_fn(0.4, 0.4, 0.4, MEAN, STD)
+    y1 = f(jax.random.key(5), x)
+    y2 = f(jax.random.key(5), x)
+    assert y1.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(y1, np.float32),
+                                  np.asarray(y2, np.float32))
+
+
+def test_engine_jitter_smoke(tmp_path):
+    """--color-jitter through engine.run, composed with mixup."""
+    from imagent_tpu.config import Config
+    from imagent_tpu.engine import run
+
+    cfg = Config(arch="resnet18", image_size=16, num_classes=4,
+                 batch_size=4, epochs=1, lr=0.05, dataset="synthetic",
+                 synthetic_size=32, workers=0, bf16=False, log_every=0,
+                 color_jitter=(0.4, 0.4, 0.2), mixup=0.2,
+                 log_dir=str(tmp_path / "tb"),
+                 ckpt_dir=str(tmp_path / "ckpt"))
+    result = run(cfg)
+    assert result["final_train"]["n"] == 32
+    assert np.isfinite(result["final_train"]["loss"])
