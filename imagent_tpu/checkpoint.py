@@ -200,6 +200,63 @@ def restore(ckpt_dir: str, name: str,
 
     state_abstract = jax.tree.map(_abstract, target)
 
+    # --ema-decay toggled between the writing run and this one changes
+    # the TrainState tree structure (ema_params None <-> params-shaped).
+    # Rather than fail every restore probe with a misleading arch error,
+    # retry with the EMA presence flipped and reconcile afterwards:
+    # missing on disk -> initialize the average from the restored params;
+    # present on disk but off now -> drop the buffers.
+    target_has_ema = getattr(target, "ema_params", None) is not None
+
+    def _ema_flipped(abstract):
+        if target_has_ema:
+            return abstract.replace(ema_params=None)
+        # EMA leaves mirror the params exactly (shape/dtype/sharding).
+        return abstract.replace(ema_params=abstract.params)
+
+    def _reconcile_ema(state):
+        """Fix up a state restored through the EMA-flipped abstract."""
+        if target_has_ema:
+            print("NOTE: checkpoint has no EMA buffers (written with "
+                  "--ema-decay off); initializing the average from the "
+                  "restored params", flush=True)
+            import jax.numpy as jnp
+            return state.replace(
+                ema_params=jax.tree.map(jnp.array, state.params))
+        print("NOTE: dropping the checkpoint's EMA buffers "
+              "(--ema-decay is off for this run)", flush=True)
+        return state.replace(ema_params=None)
+
+    def _restore_state(abstract_state, meta_fields, flip=None):
+        """Restore with the given state abstract. ``flip``: True ⇒ the
+        on-disk EMA presence is known to differ (use the flipped
+        abstract, reconcile after); False ⇒ known to match; None ⇒
+        unknown (metadata unreadable) — try as-is, fall back to flipped.
+        Returns (state, meta_tree)."""
+        mk = lambda sa: {
+            "state": sa,
+            "meta": {k: jax.ShapeDtypeStruct((), dtype)
+                     for k, dtype, _ in meta_fields},
+        }
+        if flip is None:
+            try:
+                tree = ckptr.restore(path, mk(abstract_state))
+                return tree["state"], tree["meta"]
+            except Exception as as_is_err:
+                try:
+                    tree = ckptr.restore(
+                        path, mk(_ema_flipped(abstract_state)))
+                except Exception:
+                    # Both failed: the as-is error is the informative
+                    # one (the flipped message adds spurious ema noise).
+                    raise as_is_err
+                return _reconcile_ema(tree["state"]), tree["meta"]
+        if flip:
+            tree = ckptr.restore(path, mk(_ema_flipped(abstract_state)))
+            return _reconcile_ema(tree["state"]), tree["meta"]
+        tree = ckptr.restore(path, mk(abstract_state))
+        return tree["state"], tree["meta"]
+
     ondisk = None
     try:
         ondisk = ckptr.metadata(path).item_metadata.tree
@@ -208,19 +265,29 @@ def restore(ckpt_dir: str, name: str,
 
     if isinstance(ondisk, dict) and "meta" in ondisk and "state" in ondisk:
         present = set(ondisk["meta"])
-        abstract = {
-            "state": state_abstract,
-            "meta": {k: jax.ShapeDtypeStruct((), dtype)
-                     for k, dtype, _ in _META_FIELDS if k in present},
-        }
-        tree = ckptr.restore(path, abstract)
+        fields = tuple(f for f in _META_FIELDS if f[0] in present)
+        # The metadata already reveals whether ema_params was saved (a
+        # None subtree leaves no entry) — pick the right abstract
+        # deterministically; blind double-probing is only for the
+        # metadata-unreadable path.
+        flip = None
+        if isinstance(ondisk["state"], dict):
+            flip = bool(ondisk["state"].get("ema_params")) != target_has_ema
+        state, meta_tree = _restore_state(state_abstract, fields, flip)
         meta: dict[str, Any] = {k: default
                                 for k, _, default in _META_FIELDS}
-        meta.update({k: v.item() for k, v in tree["meta"].items()})
-        return tree["state"], meta
+        meta.update({k: v.item() for k, v in meta_tree.items()})
+        return state, meta
 
     if isinstance(ondisk, dict):  # flat round-1 layout, definitively
-        state = ckptr.restore(path, state_abstract)
+        try:
+            state = ckptr.restore(path, state_abstract)
+        except Exception as as_is_err:
+            try:
+                state = _reconcile_ema(
+                    ckptr.restore(path, _ema_flipped(state_abstract)))
+            except Exception:
+                raise as_is_err
         print(f"NOTE: restored legacy-layout checkpoint {path} "
               "(pre-{state,meta} format); re-saving will migrate it",
               flush=True)
@@ -231,32 +298,45 @@ def restore(ckpt_dir: str, name: str,
     # the original 4-field set (fields are only ever appended) — a
     # {state, meta} checkpoint written by an older framework version has
     # fewer meta leaves and fails the full-set probe, which must not be
-    # misreported as a layout/arch mismatch.
-    wrapped_err: Exception | None = None
-    for n_meta in range(len(_META_FIELDS), 3, -1):
-        fields = _META_FIELDS[:n_meta]
-        abstract = {
-            "state": state_abstract,
-            "meta": {k: jax.ShapeDtypeStruct((), dtype)
-                     for k, dtype, _ in fields},
-        }
-        try:
-            tree = ckptr.restore(path, abstract)
-        except Exception as e:
-            if wrapped_err is None:
-                wrapped_err = e
-            continue
-        meta = {k: default for k, _, default in _META_FIELDS}
-        meta.update({k: v.item() for k, v in tree["meta"].items()})
-        return tree["state"], meta
+    # misreported as a layout/arch mismatch. Every probe failure is kept:
+    # the final error chains the FIRST (the current full layout's — the
+    # informative one for a genuine arch mismatch) and summarizes the
+    # rest by type.
+    probe_errs: list[Exception] = []
+    # As-is prefixes first, EMA-flipped only if every as-is probe failed
+    # (EMA presence is constant across prefixes — interleaving the flip
+    # per-prefix would double the cost of this already-expensive path).
+    for flip in (False, True):
+        for n_meta in range(len(_META_FIELDS), 3, -1):
+            fields = _META_FIELDS[:n_meta]
+            try:
+                state, meta_tree = _restore_state(
+                    state_abstract, fields, flip)
+            except Exception as e:
+                probe_errs.append(e)
+                continue
+            meta = {k: default for k, _, default in _META_FIELDS}
+            meta.update({k: v.item() for k, v in meta_tree.items()})
+            return state, meta
     try:
-        state = ckptr.restore(path, state_abstract)
-    except Exception:
+        try:
+            state = ckptr.restore(path, state_abstract)
+        except Exception as as_is_err:
+            try:
+                state = _reconcile_ema(
+                    ckptr.restore(path, _ema_flipped(state_abstract)))
+            except Exception:
+                raise as_is_err
+    except Exception as e:
+        probe_errs.append(e)
+        summary = "; ".join(
+            sorted({f"{type(p).__name__}" for p in probe_errs}))
         raise RuntimeError(
             f"checkpoint at {path} matches neither the current "
-            "{state, meta} layout nor the legacy flat-TrainState "
-            "layout — arch/--num-classes/optimizer likely differ "
-            "from the run that wrote it") from wrapped_err
+            "{state, meta} layout (with or without EMA buffers) nor "
+            "the legacy flat-TrainState layout — arch/--num-classes/"
+            f"optimizer likely differ from the run that wrote it "
+            f"(probe failures: {summary})") from probe_errs[0]
     print(f"NOTE: restored legacy-layout checkpoint {path} "
           "(pre-{state,meta} format); re-saving will migrate it",
           flush=True)

@@ -20,6 +20,16 @@ blend with the reversed batch. CutMix: Yun et al. 2019
 (arXiv:1905.04899) — paste a random box from the paired image, lam
 re-adjusted to the exact pasted-pixel ratio. When both are enabled the
 step picks one per batch with a fair coin, timm-style.
+
+Reproducibility scope: the replay guarantee holds WITHIN one fixed
+topology and execution path. The shard_map step (train.make_train_step)
+reverses each device's LOCAL batch shard, while the FSDP auto step
+(make_train_step_auto) reverses the GLOBAL batch — so identical
+flags+seed pair different images across data-parallel sizes or across
+the two step implementations. The lam draw and the per-step key are
+identical everywhere; only the partner pairing differs. This mirrors
+how torch DDP+timm pairing also changes with world size (each rank
+mixes its local batch), and is documented in README "Reproducibility".
 """
 
 from __future__ import annotations

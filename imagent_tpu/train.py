@@ -403,6 +403,12 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
 
         new_ema = state.ema_params
         if ema_decay > 0.0:  # timm ModelEma semantics: no bias correction
+            if state.ema_params is None:
+                raise ValueError(
+                    "ema_decay > 0 but state.ema_params is None — "
+                    "initialize it first, e.g. state.replace(ema_params="
+                    "jax.tree.map(jnp.array, state.params)) "
+                    "(engine.run does this for --ema-decay)")
             new_ema = jax.tree.map(
                 lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
                 state.ema_params, new_params)
@@ -503,6 +509,12 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
             state.params, jax.tree.map(lambda u: -lr * u, updates))
         new_ema = state.ema_params
         if ema_decay > 0.0:
+            if state.ema_params is None:
+                raise ValueError(
+                    "ema_decay > 0 but state.ema_params is None — "
+                    "initialize it first, e.g. state.replace(ema_params="
+                    "jax.tree.map(jnp.array, state.params)) "
+                    "(engine.run does this for --ema-decay)")
             new_ema = jax.tree.map(
                 lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
                 state.ema_params, new_params)

@@ -99,3 +99,65 @@ def test_eval_uses_ema_weights(tmp_path):
                          "ckpt_dir": str(tmp_path / "c2")}))
     assert frozen["final_val"]["loss"] != pytest.approx(
         live["final_val"]["loss"], rel=1e-6)
+
+
+def test_ema_toggle_across_restore(tmp_path):
+    """ADVICE r3 (medium): --ema-decay toggled between the writing run
+    and the resuming one changes the TrainState tree structure; restore
+    must reconcile instead of failing every probe with a misleading
+    arch-mismatch error. Off->on initializes the average from the
+    restored params; on->off drops the buffers."""
+    import jax.numpy as jnp
+
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu.cluster import make_mesh
+    from imagent_tpu.models import create_model
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, replicate_state,
+    )
+
+    mesh = make_mesh(model_parallel=1)
+    state = replicate_state(
+        create_train_state(create_model("resnet18", num_classes=4),
+                           jax.random.key(0), 16, make_optimizer()), mesh)
+    with_ema = state.replace(
+        ema_params=jax.tree.map(lambda p: jnp.array(p) * 0.5, state.params))
+
+    # Written WITHOUT ema, resumed WITH --ema-decay: the average starts
+    # from the restored params.
+    ckpt_lib.save(str(tmp_path / "a"), "last", state, {"epoch": 1})
+    got, meta = ckpt_lib.restore(str(tmp_path / "a"), "last", with_ema)
+    assert meta["epoch"] == 1
+    assert got.ema_params is not None
+    jax.tree.map(
+        lambda e, p: np.testing.assert_array_equal(
+            jax.device_get(e), jax.device_get(p)),
+        got.ema_params, got.params)
+
+    # Written WITH ema, resumed with --ema-decay off: buffers dropped.
+    ckpt_lib.save(str(tmp_path / "b"), "last", with_ema, {"epoch": 2})
+    got2, meta2 = ckpt_lib.restore(str(tmp_path / "b"), "last", state)
+    assert meta2["epoch"] == 2
+    assert got2.ema_params is None
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            jax.device_get(a), jax.device_get(b)),
+        got2.params, with_ema.params)
+
+
+def test_engine_enables_ema_mid_run(tmp_path):
+    """End-to-end: a run checkpointed without EMA resumes with
+    --ema-decay on (and back off) through engine.run."""
+    from imagent_tpu.config import Config
+    from imagent_tpu.engine import run
+
+    base = dict(arch="resnet18", image_size=16, num_classes=4,
+                batch_size=4, epochs=1, lr=0.05, dataset="synthetic",
+                synthetic_size=32, workers=0, bf16=False, log_every=0,
+                save_model=True, log_dir=str(tmp_path / "tb"),
+                ckpt_dir=str(tmp_path / "ckpt"))
+    run(Config(**base))
+    on = run(Config(**{**base, "epochs": 2}, resume=True, ema_decay=0.9))
+    assert np.isfinite(on["final_val"]["loss"])
+    off = run(Config(**{**base, "epochs": 3}, resume=True))
+    assert np.isfinite(off["final_val"]["loss"])
