@@ -257,6 +257,50 @@ def restore(ckpt_dir: str, name: str,
         tree = ckptr.restore(path, mk(abstract_state))
         return tree["state"], tree["meta"]
 
+    def _zero1_resize(abstract, ondisk_state):
+        """Cross-topology ZeRO-1: the flat momentum buffer is padded to
+        a multiple of the data-axis size (``parallel/zero.py``), so a
+        checkpoint written under a different dp has a different 1-D
+        length. Detect the length-only mismatch from the on-disk
+        metadata and restore at the ON-DISK length (replicated); the
+        caller then repads for this topology. Returns
+        (abstract, target_len or None)."""
+        tgt = getattr(abstract, "opt_state", None)
+        if not (isinstance(tgt, jax.ShapeDtypeStruct)
+                and len(tgt.shape) == 1):
+            return abstract, None
+        shape = getattr(ondisk_state.get("opt_state"), "shape", None)
+        if not (isinstance(shape, (tuple, list)) and len(shape) == 1
+                and int(shape[0]) != tgt.shape[0]):
+            return abstract, None
+        # The on-disk length can't shard evenly over the new data axis —
+        # restore it REPLICATED (on the same mesh as the rest of the
+        # state); the caller repads and the engine re-places after.
+        kw = {}
+        step_sh = getattr(getattr(abstract, "step", None), "sharding", None)
+        if isinstance(step_sh, jax.sharding.NamedSharding):
+            kw["sharding"] = jax.sharding.NamedSharding(
+                step_sh.mesh, jax.sharding.PartitionSpec())
+        return abstract.replace(opt_state=jax.ShapeDtypeStruct(
+            (int(shape[0]),), tgt.dtype, **kw)), int(tgt.shape[0])
+
+    def _repad_zero1(state, new_len: int):
+        """Unpad the restored flat buffer to the true parameter count,
+        repad (zeros) for the new data-axis size. Both paddings are
+        zeros beyond the parameter count, so the momentum content is
+        preserved exactly."""
+        total = sum(int(np.prod(np.shape(x)))
+                    for x in jax.tree_util.tree_leaves(state.params))
+        old = np.asarray(jax.device_get(state.opt_state))
+        buf = np.zeros((new_len,), old.dtype)
+        keep = min(total, new_len, old.shape[0])
+        buf[:keep] = old[:keep]
+        print(f"NOTE: repartitioned the ZeRO-1 momentum buffer "
+              f"({old.shape[0]} -> {new_len} padded elements) for the "
+              f"new data-axis size", flush=True)
+        import jax.numpy as jnp
+        return state.replace(opt_state=jnp.asarray(buf))
+
     ondisk = None
     try:
         ondisk = ckptr.metadata(path).item_metadata.tree
@@ -271,9 +315,13 @@ def restore(ckpt_dir: str, name: str,
         # deterministically; blind double-probing is only for the
         # metadata-unreadable path.
         flip = None
+        sa, zero1_len = state_abstract, None
         if isinstance(ondisk["state"], dict):
             flip = bool(ondisk["state"].get("ema_params")) != target_has_ema
-        state, meta_tree = _restore_state(state_abstract, fields, flip)
+            sa, zero1_len = _zero1_resize(state_abstract, ondisk["state"])
+        state, meta_tree = _restore_state(sa, fields, flip)
+        if zero1_len is not None:
+            state = _repad_zero1(state, zero1_len)
         meta: dict[str, Any] = {k: default
                                 for k, _, default in _META_FIELDS}
         meta.update({k: v.item() for k, v in meta_tree.items()})
@@ -331,12 +379,26 @@ def restore(ckpt_dir: str, name: str,
         probe_errs.append(e)
         summary = "; ".join(
             sorted({f"{type(p).__name__}" for p in probe_errs}))
+        # The ZeRO-1 cross-dp repartition needs the on-disk buffer
+        # length, which only the (unreadable here) metadata provides —
+        # name that case rather than blaming the arch.
+        zero1_note = ""
+        tgt_opt = getattr(state_abstract, "opt_state", None)
+        if (isinstance(tgt_opt, jax.ShapeDtypeStruct)
+                and len(tgt_opt.shape) == 1):
+            zero1_note = (
+                " NOTE: this state uses the ZeRO-1 flat optimizer "
+                "buffer, whose padded length depends on the data-axis "
+                "size; resuming --zero1 on a different device count "
+                "requires readable checkpoint metadata (unavailable "
+                "here), so a dp change is another likely cause."
+            )
         raise RuntimeError(
             f"checkpoint at {path} matches neither the current "
             "{state, meta} layout (with or without EMA buffers) nor "
             "the legacy flat-TrainState layout — arch/--num-classes/"
             f"optimizer likely differ from the run that wrote it "
-            f"(probe failures: {summary})") from probe_errs[0]
+            f"(probe failures: {summary}).{zero1_note}") from probe_errs[0]
     print(f"NOTE: restored legacy-layout checkpoint {path} "
           "(pre-{state,meta} format); re-saving will migrate it",
           flush=True)

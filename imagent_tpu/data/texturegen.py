@@ -45,17 +45,86 @@ def texture(cls: int, idx: int, n_classes: int, img: int,
     return (out.clip(0, 1) * 255).astype(np.uint8)
 
 
+def _hue_pairs(n_classes: int) -> tuple[int, list[tuple[int, int]]]:
+    """Smallest hue-bucket count whose ordered distinct pairs cover
+    ``n_classes``, plus the class→(h1, h2) table. 23 buckets ⇒ 506
+    classes — each bucket 1/23 of the hue circle, far above the JPEG
+    chroma-quantization floor that a 1/500 single-hue separation would
+    sit under."""
+    n_hues = 2
+    while n_hues * (n_hues - 1) < n_classes:
+        n_hues += 1
+    pairs = [(a, b) for a in range(n_hues) for b in range(n_hues) if a != b]
+    return n_hues, pairs[:n_classes]
+
+
+def texture_pair(cls: int, idx: int, n_classes: int, img: int,
+                 hue_jitter: float = 0.004) -> np.ndarray:
+    """Deterministic two-hue texture for ImageNet-shaped class counts
+    (≥500): class = ordered pair (dominant, secondary) of distinct hue
+    buckets, rendered as a fine-grained binary mask covering ~70%/30%
+    of the pixels. The discriminative feature — which two hues appear
+    and which dominates — is a per-crop STATISTIC, so it survives
+    RandomResizedCrop at any scale/aspect (mask correlation length ~3px:
+    even an 8%-area crop averages ~80 independent patches, σ of the
+    dominant fraction ≈ 5% ≪ the 20-point dominance margin) and hflip
+    (area statistics are reflection-invariant) — unlike grating
+    orientation, which RandomResizedCrop's aspect jitter shears across
+    buckets. Luminance gratings + noise ride on top for within-class
+    variation, exactly like :func:`texture`."""
+    rng = np.random.default_rng(cls * 100_003 + idx)
+    n_hues, pairs = _hue_pairs(n_classes)
+    h1, h2 = pairs[cls]
+
+    def hue_rgb(h: int) -> np.ndarray:
+        return np.asarray(colorsys.hsv_to_rgb(
+            (h / n_hues + rng.uniform(-hue_jitter, hue_jitter)) % 1.0,
+            0.85, 0.8), np.float32)
+
+    c_dom, c_sec = hue_rgb(h1), hue_rgb(h2)
+    # Binary occupancy mask: coarse noise upsampled 3x (correlation
+    # length ~3px), thresholded so the dominant hue covers ~70%.
+    coarse = rng.normal(size=((img + 2) // 3, (img + 2) // 3))
+    noise = np.kron(coarse, np.ones((3, 3)))[:img, :img]
+    dom = noise < np.quantile(noise, 0.70)
+    base = np.where(dom[:, :, None], c_dom[None, None, :],
+                    c_sec[None, None, :])
+    yy, xx = np.mgrid[0:img, 0:img].astype(np.float32)
+    phase = rng.uniform(0, 2 * np.pi)
+    wavelength = rng.uniform(10, 18) * img / 64.0
+    theta = rng.uniform(0, np.pi)
+    wave = np.sin(2 * np.pi * (xx * np.cos(theta) + yy * np.sin(theta))
+                  / wavelength + phase)
+    lum = 0.75 + 0.25 * wave
+    out = base * lum[:, :, None] + rng.normal(0, 0.02, base.shape)
+    return (out.clip(0, 1) * 255).astype(np.uint8)
+
+
 def generate_imagefolder(root: str, n_classes: int = 8,
                          train_per_class: int = 40, val_per_class: int = 8,
                          img: int = 64, quality: int = 90,
-                         hue_jitter: float = 0.03) -> str:
+                         hue_jitter: float | None = None,
+                         scheme: str = "hue") -> str:
     """Write the dataset under ``root`` (idempotent: a manifest records
-    the parameters; matching manifest ⇒ reuse, mismatch ⇒ regenerate)."""
+    the parameters; matching manifest ⇒ reuse, mismatch ⇒ regenerate).
+    ``scheme``: "hue" (single-hue classes, up to ~64 before the JPEG
+    chroma floor) or "huepair" (:func:`texture_pair`, ImageNet-shaped
+    class counts). ``hue_jitter`` defaults PER SCHEME: 0.03 for "hue"
+    (vs 1/n_classes bucket spacing) but 0.004 for "huepair", whose 23
+    hue buckets sit only 1/23 ≈ 0.0435 apart — a 0.03 jitter there
+    would overlap adjacent buckets and turn the class feature into
+    label noise."""
     from PIL import Image
 
+    gen = {"hue": texture, "huepair": texture_pair}[scheme]
+    if hue_jitter is None:
+        hue_jitter = 0.03 if scheme == "hue" else 0.004
     manifest = dict(n_classes=n_classes, train_per_class=train_per_class,
                     val_per_class=val_per_class, img=img, quality=quality,
                     hue_jitter=hue_jitter, version=1)
+    if scheme != "hue":
+        manifest["scheme"] = scheme  # absent for "hue": round-2/3
+        # manifests stay valid, existing datasets aren't regenerated
     mpath = os.path.join(root, "manifest.json")
     if os.path.exists(mpath):
         try:
@@ -77,7 +146,7 @@ def generate_imagefolder(root: str, n_classes: int = 8,
             os.makedirs(d, exist_ok=True)
             for i in range(per_class):
                 Image.fromarray(
-                    texture(cls, base + i, n_classes, img, hue_jitter)).save(
+                    gen(cls, base + i, n_classes, img, hue_jitter)).save(
                         os.path.join(d, f"{i:05d}.jpg"), quality=quality)
     with open(mpath, "w") as f:
         json.dump(manifest, f)

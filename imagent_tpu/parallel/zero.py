@@ -21,9 +21,11 @@ coordinate.
 Layout: the param tree is flattened with ``jax.flatten_util.ravel_pytree``
 and zero-padded to a multiple of the axis size, so arbitrary leaf shapes
 (conv kernels with dim0=3, scalars) shard evenly. The flat buffer is the
-checkpointed ``opt_state`` — resume works across different data-axis
-sizes only when the padded length matches; keep dp fixed across a
-resumed run (same constraint DDP has implicitly).
+checkpointed ``opt_state``; a resume onto a DIFFERENT data-axis size
+restores it at the on-disk padded length and repads for the new dp
+(``checkpoint.restore`` — the padding beyond the true parameter count is
+zeros under both layouts, so the momentum content round-trips exactly;
+``tests/test_topology_resume.py`` pins the 8→4 case).
 """
 
 from __future__ import annotations

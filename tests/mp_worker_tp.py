@@ -1,0 +1,94 @@
+"""Worker for the cross-process MODEL-axis test (test_multiprocess.py).
+
+The plain two-process test (mp_worker.py) crosses only the ``data``
+axis: each process's devices form complete model replicas, so every
+collective that crosses the process boundary is a gradient psum — the
+DCN-friendly case. Real pods also run the other case: a mesh whose
+``model`` axis spans processes, where TENSOR-PARALLEL activation
+collectives (psum of partial matmul products inside the forward/backward)
+cross the boundary. The reference cannot express this at all (its NCCL
+world is flat DDP, ``imagenet.py:270-273``); here the permuted mesh
+places model-pair devices in DIFFERENT processes and runs the real TP
+train step over it.
+
+Device layout: 2 processes x 2 fake devices = [d0 d1 | d2 d3].
+``reshape(2, 2).T`` pairs (d0, d2) and (d1, d3) as the model axis —
+every TP collective crosses the process boundary; the data axis is
+within-process. Each process holds one model shard of EVERY data row,
+so both feed the full global batch (make_array_from_process_local_data
+takes each process's addressable rows — here, all of them).
+
+Usage: python mp_worker_tp.py <rank> <port>
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2")
+    os.environ.update({
+        "SLURM_JOB_NUM_NODES": "2",
+        "SLURM_NODEID": str(rank),
+        "SLURM_LOCALID": "0",
+        "SLURM_PROCID": str(rank),
+        "SLURM_NTASKS": "2",
+        "SLURM_JOB_NODELIST": "127.0.0.1",
+    })
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from imagent_tpu import cluster
+    from imagent_tpu.models.vit import VisionTransformer
+    from imagent_tpu.parallel.tensor_parallel import vit_tp_param_specs
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step, place_state,
+        shard_batch, state_partition_specs,
+    )
+
+    senv = cluster.initialize("cpu", port=port)
+    assert senv is not None and senv.world_size == 2
+    print(cluster.rank_banner(senv), flush=True)
+
+    # Permuted mesh: model pairs (d0, d2), (d1, d3) span the processes.
+    devs = np.asarray(jax.devices()).reshape(2, 2).T.reshape(2, 1, 2)
+    mesh = Mesh(devs, (cluster.DATA_AXIS, cluster.PIPE_AXIS,
+                       cluster.MODEL_AXIS))
+    crossing = {d.process_index for d in devs[0, 0, :]}
+    assert crossing == {0, 1}, "model axis must span both processes"
+
+    vit_kw = dict(patch_size=8, hidden_dim=32, num_layers=2,
+                  num_heads=4, mlp_dim=64, num_classes=4)
+    model = VisionTransformer(**vit_kw, tp_axis=cluster.MODEL_AXIS)
+    init_model = VisionTransformer(**vit_kw)  # unsharded init twin
+    opt = make_optimizer()
+    state = create_train_state(init_model, jax.random.key(0), 32, opt)
+    specs = state_partition_specs(state, vit_tp_param_specs(state.params))
+    state = place_state(state, mesh, specs)
+    step = make_train_step(model, opt, mesh, state_specs=specs)
+
+    # Both processes hold a model shard of every data row, so both feed
+    # the identical full global batch.
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(8,)).astype(np.int32)
+    gi, gl = shard_batch(mesh, images, labels)
+    assert gi.shape == (8, 32, 32, 3)
+
+    _, metrics = step(state, gi, gl, np.float32(0.05))
+    m = np.asarray(metrics)
+    print("METRICS", " ".join(f"{x:.6f}" for x in m), flush=True)
+
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

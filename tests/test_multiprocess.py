@@ -93,3 +93,67 @@ def test_two_process_train_step_matches_single():
     _, want = step(state, gi, gl, np.float32(0.05))
     np.testing.assert_allclose(metrics[0], np.asarray(want),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_cross_process_model_axis_matches_single():
+    """The model (TP) axis crossing the OS-process boundary — the case
+    real pods hit when a tensor-parallel group spans hosts. Two
+    processes form a permuted 4-device mesh whose model pairs live in
+    DIFFERENT processes, so the TP activation psums (not just the
+    gradient reduce) cross the boundary. Both ranks must agree and
+    match a single-process run of the same sharded computation."""
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(_DIR, "mp_worker_tp.py"),
+             str(rank), str(port)],
+            cwd=_REPO, env=_clean_env(),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for rank in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+
+    metrics = []
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("METRICS")]
+        assert line, out
+        metrics.append(np.array([float(x) for x in line[0].split()[1:]]))
+    np.testing.assert_allclose(metrics[0], metrics[1], rtol=1e-6)
+    assert metrics[0][3] == 8.0  # the count spans the full global batch
+
+    # Single-process reference: same TP sharding on an in-process mesh.
+    import jax
+
+    from imagent_tpu import cluster
+    from imagent_tpu.models.vit import VisionTransformer
+    from imagent_tpu.parallel.tensor_parallel import vit_tp_param_specs
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step, place_state,
+        shard_batch, state_partition_specs,
+    )
+
+    mesh = cluster.make_mesh(model_parallel=2,
+                             devices=jax.devices()[:4])
+    vit_kw = dict(patch_size=8, hidden_dim=32, num_layers=2,
+                  num_heads=4, mlp_dim=64, num_classes=4)
+    model = VisionTransformer(**vit_kw, tp_axis=cluster.MODEL_AXIS)
+    opt = make_optimizer()
+    state = create_train_state(VisionTransformer(**vit_kw),
+                               jax.random.key(0), 32, opt)
+    specs = state_partition_specs(state, vit_tp_param_specs(state.params))
+    state = place_state(state, mesh, specs)
+    step = make_train_step(model, opt, mesh, state_specs=specs)
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(8,)).astype(np.int32)
+    gi, gl = shard_batch(mesh, images, labels)
+    _, want = step(state, gi, gl, np.float32(0.05))
+    np.testing.assert_allclose(metrics[0], np.asarray(want),
+                               rtol=1e-4, atol=1e-4)

@@ -61,3 +61,38 @@ def test_resume_on_more_devices(tmp_path):
     assert second.returncode == 0, (second.stdout, second.stderr)
     assert "resumed from epoch 1" in second.stdout, second.stdout
     assert "Epoch 2:" in second.stdout
+
+
+def test_zero1_resume_across_data_axis_sizes(tmp_path):
+    """ZeRO-1's flat momentum buffer is padded to a multiple of dp;
+    resuming on a different data-axis size must repartition it (restore
+    at the on-disk length, repad for the new dp) rather than fail the
+    restore. 8 -> 4 devices, through the CLI."""
+
+    def run_zero1(n_devices, epochs, resume):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={n_devices}")
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        cmd = [sys.executable, "-m", "imagent_tpu", "--backend=cpu",
+               "--dataset=synthetic", "--arch=resnet18", "--image-size=16",
+               "--num-classes=4", "--batch-size=8", "--seed=7", "--zero1",
+               f"--epochs={epochs}", "--synthetic-size=32", "--workers=0",
+               "--log-every=0", "--save-model",
+               f"--ckpt-dir={tmp_path / 'ckpt'}",
+               f"--log-dir={tmp_path / 'tb'}"]
+        if resume:
+            cmd.append("--resume")
+        return subprocess.run(cmd, env=env, cwd=_REPO, capture_output=True,
+                              text=True, timeout=420)
+
+    first = run_zero1(8, epochs=1, resume=False)
+    assert first.returncode == 0, (first.stdout, first.stderr)
+
+    second = run_zero1(4, epochs=2, resume=True)
+    assert second.returncode == 0, (second.stdout, second.stderr)
+    assert "repartitioned the ZeRO-1 momentum buffer" in second.stdout, \
+        second.stdout
+    assert "resumed from epoch 1" in second.stdout, second.stdout
+    assert "Epoch 2:" in second.stdout
