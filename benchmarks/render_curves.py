@@ -1,0 +1,106 @@
+"""Render training curves from TensorBoard event files to one PNG.
+
+The reference ships rendered curves as its README artifact
+(`/root/reference/README.md:5` links Graphs.PNG); this produces the
+framework's analogue straight from the event files the torch-free
+writer (utils/tb_writer.py) emits — loss / top-1 / top-5 (train + val)
+and the LR schedule vs epoch, four small multiples sharing the epoch
+axis (never a dual-axis chart).
+
+    python benchmarks/render_curves.py --log-dir runs/<run> \
+        --out docs/runs/<run>_curves.png [--title "..."]
+
+Layout (dataviz method): train/val are categorical slots 1/2 of the
+validated reference palette (blue #2a78d6 / orange #eb6834 — the
+adjacent-pair CVD separation is validated there), 2px lines, recessive
+grid, direct end-labels plus a single legend, text in ink tokens (not
+series colors), light surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+SURFACE = "#fcfcfb"
+INK = "#0b0b0b"
+INK_2 = "#52514e"
+GRID = "#e4e3df"
+TRAIN = "#2a78d6"  # categorical slot 1 (blue)
+VAL = "#eb6834"    # categorical slot 2 (orange)
+
+
+def read_scalar(log_dir: str, sub: str, tag: str):
+    """[(step, value)] from one event subdir, sorted by step."""
+    from tensorboard.backend.event_processing import event_accumulator
+
+    d = os.path.join(log_dir, sub) if sub else log_dir
+    ea = event_accumulator.EventAccumulator(
+        d, size_guidance={event_accumulator.SCALARS: 0})
+    ea.Reload()
+    if tag not in ea.Tags().get("scalars", ()):
+        return []
+    ev = ea.Scalars(tag)
+    return sorted((e.step, e.value) for e in ev)
+
+
+def render(log_dir: str, out: str, title: str | None = None) -> str:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    panels = [
+        ("Loss", "Loss", [("Loss_train", "train"), ("Loss_test", "val")]),
+        ("Top-1 accuracy (%)", "Top1",
+         [("Top1_train", "train"), ("Top1_test", "val")]),
+        ("Top-5 accuracy (%)", "Top5",
+         [("Top5_train", "train"), ("Top5_test", "val")]),
+        ("Learning rate", "lr", [("", "lr")]),
+    ]
+    fig, axes = plt.subplots(2, 2, figsize=(10, 7), dpi=150,
+                             facecolor=SURFACE, sharex=True)
+    for ax, (ylabel, tag, series) in zip(axes.flat, panels):
+        ax.set_facecolor(SURFACE)
+        for sub, label in series:
+            pts = read_scalar(log_dir, sub, tag)
+            if not pts:
+                continue
+            xs, ys = zip(*pts)
+            color = TRAIN if label in ("train", "lr") else VAL
+            ax.plot(xs, ys, color=color, linewidth=2, label=label)
+            # Direct end label (selective, never every point).
+            ax.annotate(f" {label} {ys[-1]:.4g}", (xs[-1], ys[-1]),
+                        color=INK_2, fontsize=8, va="center")
+        ax.set_ylabel(ylabel, color=INK, fontsize=10)
+        ax.grid(True, color=GRID, linewidth=0.8)
+        ax.tick_params(colors=INK_2, labelsize=8)
+        for s in ax.spines.values():
+            s.set_color(GRID)
+        ax.margins(x=0.02)
+        if len(series) > 1:
+            ax.legend(frameon=False, fontsize=8, labelcolor=INK_2)
+    for ax in axes[1]:
+        ax.set_xlabel("epoch", color=INK, fontsize=10)
+    if title:
+        fig.suptitle(title, color=INK, fontsize=12)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    fig.savefig(out, facecolor=SURFACE, bbox_inches="tight")
+    plt.close(fig)
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--log-dir", required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--title", default=None)
+    a = p.parse_args()
+    print(render(a.log_dir, a.out, a.title))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

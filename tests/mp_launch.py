@@ -1,0 +1,62 @@
+"""Shared harness for the two-OS-process workers (mp_worker.py,
+mp_worker_tp.py): free-port rendezvous, env scrub, paired spawn with
+collect/kill, and METRICS-line parsing. Used by both
+tests/test_multiprocess.py and the driver's dryrun phase
+(__graft_entry__._dryrun_cross_process_model_axis) so the spawn
+contract can't drift between them."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+
+
+def clean_env() -> dict:
+    """The workers pin their own platform/device-count/Slurm vars."""
+    env = dict(os.environ)
+    for k in ("XLA_FLAGS", "JAX_PLATFORMS"):
+        env.pop(k, None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_pair(worker: str, timeout: float = 300) -> list[str]:
+    """Run ranks 0 and 1 of ``worker`` (a path under tests/) against a
+    fresh rendezvous port; return both outputs. Raises AssertionError
+    with the combined output if either rank fails."""
+    port = free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(_DIR, worker), str(rank), str(port)],
+        cwd=_REPO, env=clean_env(),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for rank in (0, 1)]
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"{worker} rank failed:\n{out}"
+    return outs
+
+
+def parse_metrics(out: str) -> np.ndarray:
+    """The METRICS vector a worker prints."""
+    lines = [ln for ln in out.splitlines() if ln.startswith("METRICS")]
+    assert lines, out
+    return np.array([float(x) for x in lines[0].split()[1:]])

@@ -7,58 +7,14 @@ metrics, equal to a single-process run on the concatenated batch —
 the DDP-equivalence invariant, for real this time (the rest of the
 suite fakes multi-device inside one process)."""
 
-import os
-import subprocess
-import sys
-
 import numpy as np
-import pytest
 
-_DIR = os.path.dirname(os.path.abspath(__file__))
-_REPO = os.path.dirname(_DIR)
-
-
-def _clean_env():
-    env = dict(os.environ)
-    # The workers set their own platform/device-count/Slurm vars.
-    for k in ("XLA_FLAGS", "JAX_PLATFORMS"):
-        env.pop(k, None)
-    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-    return env
-
-
-def _free_port() -> int:
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from mp_launch import launch_pair, parse_metrics
 
 
 def test_two_process_train_step_matches_single():
-    port = _free_port()
-    procs = [
-        subprocess.Popen(
-            [sys.executable, os.path.join(_DIR, "mp_worker.py"),
-             str(rank), str(port)],
-            cwd=_REPO, env=_clean_env(),
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for rank in (0, 1)
-    ]
-    try:
-        outs = [p.communicate(timeout=300)[0] for p in procs]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, out
-
-    metrics = []
-    for out in outs:
-        line = [ln for ln in out.splitlines() if ln.startswith("METRICS")]
-        assert line, out
-        metrics.append(np.array([float(x) for x in line[0].split()[1:]]))
+    outs = launch_pair("mp_worker.py")
+    metrics = [parse_metrics(out) for out in outs]
     np.testing.assert_allclose(metrics[0], metrics[1], rtol=1e-6)
     assert metrics[0][3] == 8.0  # psum'd count spans both processes
 
@@ -102,29 +58,8 @@ def test_cross_process_model_axis_matches_single():
     DIFFERENT processes, so the TP activation psums (not just the
     gradient reduce) cross the boundary. Both ranks must agree and
     match a single-process run of the same sharded computation."""
-    port = _free_port()
-    procs = [
-        subprocess.Popen(
-            [sys.executable, os.path.join(_DIR, "mp_worker_tp.py"),
-             str(rank), str(port)],
-            cwd=_REPO, env=_clean_env(),
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for rank in (0, 1)
-    ]
-    try:
-        outs = [p.communicate(timeout=300)[0] for p in procs]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, out
-
-    metrics = []
-    for out in outs:
-        line = [ln for ln in out.splitlines() if ln.startswith("METRICS")]
-        assert line, out
-        metrics.append(np.array([float(x) for x in line[0].split()[1:]]))
+    outs = launch_pair("mp_worker_tp.py")
+    metrics = [parse_metrics(out) for out in outs]
     np.testing.assert_allclose(metrics[0], metrics[1], rtol=1e-6)
     assert metrics[0][3] == 8.0  # the count spans the full global batch
 
