@@ -135,8 +135,8 @@ def main() -> int:
     _sync(gi, gl)
     h2d_s = time.time() - t0
     h2d_img_s = global_batch / h2d_s / n_chips
-    compute = measure(a.arch, a.image_size, a.batch_size, iters=5,
-                      windows=2)
+    compute = measure(a.arch, a.image_size, a.batch_size, pairs=3,
+                      lo_iters=2, hi_iters=8)
     stages = {"decode": decode_img_s, "h2d": h2d_img_s,
               "compute": compute["value"]}
 

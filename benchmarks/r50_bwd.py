@@ -1,0 +1,165 @@
+"""ResNet-50 backward-pass anatomy (VERDICT r3 #4).
+
+The r50@224 b256 train step measures ~106 ms vs a ~72 ms idealized
+HBM-roofline bound; the forward passes are already characterized
+(docs/ROOFLINE.md) but the ~71 ms backward was one opaque number.
+This splits the step into measured phases:
+
+  fwd_eval    — inference forward (running-stat BN)
+  fwd_train   — training forward (batch-stat BN, stats returned)
+  grad_eval   — value+grad of the loss in EVAL-BN mode (isolates the
+                pure conv/matmul transpose cost from BN-stat traffic)
+  grad_train  — value+grad in train-BN mode WITH new batch stats (the
+                real training backward)
+  full_step   — the production jitted train step (adds pmean + SGD
+                update + metric psum)
+
+and measures the train-BN levers the roofline called unexplored:
+
+  grad_train_nostats — train-mode BN normalization but WITHOUT
+                       emitting new running stats (XLA can DCE the
+                       stat-update pass): bounds the stat-traffic cost
+  grad_train_remat   — same with jax.checkpoint over the blocks
+                       (recompute-fwd-in-bwd trades HBM for flops)
+
+Derived lines: bwd_only = grad_train - fwd_train; stat_cost =
+grad_train - grad_train_nostats; update_cost = full_step - grad_train.
+
+    python benchmarks/r50_bwd.py [--batch 256 --size 224]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _timed(f, *args, reps=10, windows=3):
+    """Median-of-windows chained timing with a hard device fetch."""
+    out = f(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])  # compile
+    best = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        best.append((time.perf_counter() - t0) / reps)
+    return float(np.median(best))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--size", type=int, default=224)
+    p.add_argument("--arch", default="resnet50")
+    a = p.parse_args()
+
+    import jax.numpy as jnp
+
+    from imagent_tpu.cluster import make_mesh
+    from imagent_tpu.models import create_model
+    from imagent_tpu.ops import softmax_cross_entropy
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step,
+        replicate_state, shard_batch,
+    )
+
+    mesh = make_mesh(model_parallel=1)
+    model = create_model(a.arch, num_classes=1000, bf16=True)
+    model_remat = create_model(a.arch, num_classes=1000, bf16=True,
+                               remat=True)
+    opt = make_optimizer()
+    state = replicate_state(
+        create_train_state(model, jax.random.key(0), a.size, opt,
+                           batch_size=2), mesh)
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(a.batch, a.size, a.size, 3)).astype(
+        jnp.bfloat16)
+    labels = rng.integers(0, 1000, size=(a.batch,)).astype(np.int32)
+    gi, gl = shard_batch(mesh, images, labels)
+    params, bstats = state.params, state.batch_stats
+    y = jnp.asarray(gl)
+
+    def loss_eval(p, x):
+        logits = model.apply({"params": p, "batch_stats": bstats}, x,
+                             train=False)
+        return softmax_cross_entropy(logits, y).mean()
+
+    def loss_train(p, x):
+        logits, upd = model.apply(
+            {"params": p, "batch_stats": bstats}, x, train=True,
+            mutable=["batch_stats"])
+        return softmax_cross_entropy(logits, y).mean(), upd
+
+    def loss_train_nostats(p, x):
+        logits, _ = model.apply(
+            {"params": p, "batch_stats": bstats}, x, train=True,
+            mutable=["batch_stats"])
+        return softmax_cross_entropy(logits, y).mean()
+
+    def loss_train_remat(p, x):
+        logits, upd = model_remat.apply(
+            {"params": p, "batch_stats": bstats}, x, train=True,
+            mutable=["batch_stats"])
+        return softmax_cross_entropy(logits, y).mean(), upd
+
+    phases = {
+        "fwd_eval": jax.jit(lambda p, x: loss_eval(p, x)),
+        "fwd_train": jax.jit(lambda p, x: loss_train(p, x)[0]),
+        "grad_eval": jax.jit(jax.grad(loss_eval)),
+        "grad_train": jax.jit(jax.grad(loss_train, has_aux=True)),
+        "grad_train_nostats": jax.jit(jax.grad(loss_train_nostats)),
+        "grad_train_remat": jax.jit(
+            jax.grad(loss_train_remat, has_aux=True)),
+    }
+    out = {"arch": a.arch, "size": a.size, "batch": a.batch}
+    for name, f in phases.items():
+        out[f"{name}_ms"] = round(_timed(f, params, gi) * 1e3, 2)
+
+    step = make_train_step(model, opt, mesh)
+    st = state
+    lr = np.float32(0.1)
+
+    def full(s):
+        s2, m = step(s, gi, gl, lr)
+        return s2, m
+
+    # state-chained full step
+    for _ in range(3):
+        st, m = step(st, gi, gl, lr)
+    np.asarray(m)
+    best = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            st, m = step(st, gi, gl, lr)
+        np.asarray(m)
+        best.append((time.perf_counter() - t0) / 10)
+    out["full_step_ms"] = round(float(np.median(best)) * 1e3, 2)
+
+    out["derived"] = {
+        "bwd_only_ms": round(out["grad_train_ms"] - out["fwd_train_ms"],
+                             2),
+        "bn_stat_cost_ms": round(
+            out["grad_train_ms"] - out["grad_train_nostats_ms"], 2),
+        "update_overhead_ms": round(
+            out["full_step_ms"] - out["grad_train_ms"], 2),
+        "remat_delta_ms": round(
+            out["grad_train_remat_ms"] - out["grad_train_ms"], 2),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,10 +8,11 @@
         --batch-size 256 --optimizer adamw
 
 Thin CLI over ``bench.measure`` — one measurement harness (jitted SPMD
-train step, device-resident bf16 synthetic batches, best-of-N windows,
-analytic-FLOPs MFU) shared with the driver benchmark, so methodology
-can't drift between the two. Prints one JSON line per run including
-``tflops_per_chip`` / ``mfu_pct``.
+train step, device-resident bf16 synthetic batches, paired-window
+differencing with a median estimator, analytic-FLOPs MFU) shared with
+the driver benchmark, so methodology can't drift between the two.
+Prints one JSON line per run including ``tflops_per_chip`` /
+``mfu_pct``.
 """
 
 from __future__ import annotations
@@ -32,8 +33,9 @@ def main() -> int:
     p.add_argument("--batch-size", type=int, default=128,
                    help="per chip")
     p.add_argument("--optimizer", default="sgd")
-    p.add_argument("--windows", type=int, default=3)
-    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--pairs", type=int, default=5)
+    p.add_argument("--lo-iters", type=int, default=3)
+    p.add_argument("--hi-iters", type=int, default=15)
     p.add_argument("--no-bf16", dest="bf16", action="store_false",
                    default=True)
     a = p.parse_args()
@@ -41,8 +43,8 @@ def main() -> int:
     from bench import measure
 
     out = measure(a.arch, a.image_size, a.batch_size,
-                  optimizer=a.optimizer, bf16=a.bf16,
-                  windows=a.windows, iters=a.iters)
+                  optimizer=a.optimizer, bf16=a.bf16, pairs=a.pairs,
+                  lo_iters=a.lo_iters, hi_iters=a.hi_iters)
     out["optimizer"] = a.optimizer
     out["bf16"] = a.bf16
     print(json.dumps(out))

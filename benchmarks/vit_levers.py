@@ -1,0 +1,66 @@
+"""ViT MFU levers, measured (VERDICT r3 #6).
+
+ViT-B/16@224 b256 measured ~41% MFU against a self-stated 60-65%
+ceiling; the diagnosed causes (197 tokens vs the 256-lane MXU tile,
+head_dim 64, three separate QKV GEMMs) had no measured levers. Each
+config below is one lever (or a composition), measured with the
+shared paired-window estimator (bench.measure):
+
+  base        — vit_b16@224 b256 adamw (the headline config)
+  fused       — --fused-qkv: one [768, 3*768] QKV GEMM per block
+  reg59       — --register-tokens 59: 197 -> 256 tokens, so every
+                attention/LN/MLP op runs on exactly two 128-lane
+                tiles instead of 197 (= 2 tiles: 69% pad waste in
+                the second). MFU is reported against the REAL
+                (197-token-equivalent) flops — registers are padding
+                that does useful-shaped work, so the win must show
+                up as img/s, not as inflated flops.
+  fused+reg   — both
+  b512        — batch 512 (MXU batch-dim tiling at the larger M)
+  flash448    — 448px (785 tokens) full vs flash attention: the
+                regime where O(N^2) materialization starts to hurt
+                and the Pallas kernel should win (it predictably
+                loses at n=197).
+
+    python benchmarks/vit_levers.py          # on the TPU chip
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from bench import measure
+
+    rows = [
+        ("base", dict(), 224, 256),
+        ("fused", dict(model_kw={"fused_qkv": True}), 224, 256),
+        ("reg59", dict(model_kw={"register_tokens": 59}), 224, 256),
+        ("fused+reg", dict(model_kw={"fused_qkv": True,
+                                     "register_tokens": 59}), 224, 256),
+        ("b512", dict(), 224, 512),
+        ("b512+fused+reg", dict(model_kw={"fused_qkv": True,
+                                          "register_tokens": 59}),
+         224, 512),
+        ("flash448", dict(model_kw={"attn_impl": "flash"}), 448, 64),
+        ("full448", dict(), 448, 64),
+    ]
+    for name, kw, size, batch in rows:
+        try:
+            out = measure("vit_b16", size, batch, optimizer="adamw", **kw)
+            out["lever"] = name
+            print(json.dumps(out))
+        except Exception as e:  # noqa: BLE001 — print and continue
+            print(json.dumps({"lever": name,
+                              "error": f"{type(e).__name__}: {e}"[:200]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -146,6 +146,12 @@ class Config:
     # Single-chip attention kernel (ViT only): full (XLA einsum) | flash
     # (Pallas fused kernel, ops/flash_attention.py).
     attn: str = "full"
+    # ViT perf/regularization levers (models/vit.py): one-GEMM QKV
+    # projection (same param tree) and DINOv2-style register tokens
+    # (appended, excluded from readout; 59 fills 224px ViT-B/16's 197
+    # tokens to the 256-lane MXU tile).
+    fused_qkv: bool = False
+    register_tokens: int = 0
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -270,6 +276,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attn", type=str, default=c.attn,
                    choices=["full", "flash"],
                    help="ViT attention kernel (flash = Pallas fused)")
+    p.add_argument("--fused-qkv", action="store_true",
+                   default=c.fused_qkv,
+                   help="ViT: one fused QKV GEMM (same param tree)")
+    p.add_argument("--register-tokens", type=int,
+                   default=c.register_tokens,
+                   help="ViT: learned register tokens appended to the "
+                        "sequence, excluded from readout (59 fills "
+                        "224px ViT-B/16 to the 256-token MXU tile)")
     return p
 
 

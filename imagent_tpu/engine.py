@@ -325,6 +325,17 @@ def run(cfg: Config, stop_check=None) -> dict:
         cfg, jax.process_index(), jax.process_count(), global_batch,
         skip_train=cfg.eval_only)
 
+    if ((cfg.fused_qkv or cfg.register_tokens)
+            and not cfg.arch.startswith("vit")):
+        raise ValueError("--fused-qkv / --register-tokens apply to the "
+                         "ViT family only")
+    # ViT perf levers ride every ViT construction site (model and init
+    # twin alike — register tokens add params, so the trees must agree;
+    # fused_qkv keeps the tree unchanged either way).
+    vit_kw = ({"fused_qkv": cfg.fused_qkv,
+               "register_tokens": cfg.register_tokens}
+              if cfg.arch.startswith("vit") else {})
+
     if use_sp:
         # Optionally pipelined: layers shard over `pipe`, tokens over
         # `model` — the ring/Ulysses collectives run inside each stage.
@@ -333,11 +344,12 @@ def run(cfg: Config, stop_check=None) -> dict:
         model = create_model(
             cfg.arch, cfg.num_classes, cfg.bf16, gap_readout=True,
             attn_impl=cfg.seq_parallel, seq_axis=cluster.MODEL_AXIS,
-            remat=cfg.remat, **pp_kw)
+            remat=cfg.remat, **pp_kw, **vit_kw)
         # Same param tree, no mesh-axis ops — usable for host-side init.
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                                   gap_readout=True, remat=cfg.remat,
-                                  **({"stacked": True} if use_pp else {}))
+                                  **({"stacked": True} if use_pp else {}),
+                                  **vit_kw)
     elif cfg.moe_every:
         moe_kw = dict(moe_every=cfg.moe_every, num_experts=cfg.num_experts,
                       capacity_factor=cfg.capacity_factor,
@@ -347,7 +359,7 @@ def run(cfg: Config, stop_check=None) -> dict:
         model = create_model(
             cfg.arch, cfg.num_classes, cfg.bf16, attn_impl=cfg.attn,
             expert_axis=cluster.MODEL_AXIS if use_ep else None,
-            **moe_kw, **pp_kw, remat=cfg.remat)
+            **moe_kw, **pp_kw, remat=cfg.remat, **vit_kw)
         # Host-side init twin: same param tree; EP consumes slices of it.
         # groups=1 — params don't depend on the capacity grouping, and
         # the init batch (2 images) need not divide the run's groups.
@@ -355,7 +367,8 @@ def run(cfg: Config, stop_check=None) -> dict:
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                                   attn_impl=cfg.attn,
                                   **({"stacked": True} if use_pp else {}),
-                                  **{**moe_kw, "moe_groups": 1}, remat=cfg.remat)
+                                  **{**moe_kw, "moe_groups": 1},
+                                  remat=cfg.remat, **vit_kw)
     elif use_pp and not cfg.arch.startswith("vit"):
         # ResNet family: 2-stage GPipe over heterogeneous conv stages,
         # params replicated over pipe (parallel/resnet_pipeline.py).
@@ -367,24 +380,30 @@ def run(cfg: Config, stop_check=None) -> dict:
         model = create_model(
             cfg.arch, cfg.num_classes, cfg.bf16, attn_impl=cfg.attn,
             pipe_axis=cluster.PIPE_AXIS, microbatches=cfg.microbatches,
-            tp_axis=cluster.MODEL_AXIS if use_tp else None, remat=cfg.remat)
+            tp_axis=cluster.MODEL_AXIS if use_tp else None,
+            remat=cfg.remat, **vit_kw)
         # Host-side init uses the layer-stacked pipe-free twin (same
         # param tree, parallel/pipeline.py).
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                                  attn_impl=cfg.attn, stacked=True, remat=cfg.remat)
+                                  attn_impl=cfg.attn, stacked=True,
+                                  remat=cfg.remat, **vit_kw)
     elif use_tp and not cfg.fsdp:
         model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                             attn_impl=cfg.attn, tp_axis=cluster.MODEL_AXIS, remat=cfg.remat)
+                             attn_impl=cfg.attn,
+                             tp_axis=cluster.MODEL_AXIS,
+                             remat=cfg.remat, **vit_kw)
         # Host-side init uses the unsharded twin; TP consumes slices of
         # the same param tree (parallel/tensor_parallel.py).
         init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                                  attn_impl=cfg.attn, remat=cfg.remat)
+                                  attn_impl=cfg.attn, remat=cfg.remat,
+                                  **vit_kw)
     elif cfg.arch.startswith("vit") and cfg.attn != "full":
         model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                             attn_impl=cfg.attn, remat=cfg.remat)
+                             attn_impl=cfg.attn, remat=cfg.remat,
+                             **vit_kw)
         init_model = model
     else:
-        kw = {} if cfg.arch.startswith("vit") else {"stem": cfg.stem}
+        kw = vit_kw if cfg.arch.startswith("vit") else {"stem": cfg.stem}
         model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                              remat=cfg.remat, **kw)
         init_model = model

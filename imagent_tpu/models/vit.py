@@ -41,6 +41,36 @@ def _make_attn_fn(attn_impl: str, seq_axis: str | None):
     raise ValueError(f"unknown attn_impl {attn_impl!r}")
 
 
+class _ProjParams(nn.Module):
+    """Parameter-only twin of a ``DenseGeneral(features=(heads, hd))``
+    projection: declares the SAME {kernel, bias} leaves (same names,
+    shapes, and initializers) without computing the GEMM, so the fused
+    QKV path below shares one param tree — and therefore checkpoints,
+    torch import/export, and TP spec trees — with the unfused path."""
+
+    in_dim: int
+    heads: int
+    head_dim: int
+
+    @nn.compact
+    def __call__(self):
+        def kernel_init(key, shape, dtype):
+            # DenseGeneral draws on the FLATTENED (in, heads*hd) shape
+            # (fan_in = in_dim) and reshapes; drawing lecun_normal
+            # directly on the 3-D shape would use fan_in = in_dim*heads
+            # and under-scale by sqrt(heads).
+            flat = nn.initializers.lecun_normal()(
+                key, (self.in_dim, self.heads * self.head_dim), dtype)
+            return flat.reshape(shape)
+
+        kernel = self.param("kernel", kernel_init,
+                            (self.in_dim, self.heads, self.head_dim),
+                            jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.heads, self.head_dim), jnp.float32)
+        return kernel, bias
+
+
 class MultiHeadAttention(nn.Module):
     """MHA with explicit q/k/v/out projections (param layout equivalent to
     torch's fused in_proj + out_proj).
@@ -49,13 +79,20 @@ class MultiHeadAttention(nn.Module):
     (each shard projects onto its local heads), attention runs on local
     heads with zero communication, and the out projection is row-parallel
     (one psum). Params are slices of the unsharded tree
-    (``parallel/tensor_parallel.py``)."""
+    (``parallel/tensor_parallel.py``).
+
+    ``fused_qkv`` computes the three projections as ONE
+    ``[d, 3*heads*head_dim]`` GEMM from the same three param tensors
+    (concatenated at apply time — a cheap bf16 copy XLA fuses), turning
+    three MXU passes over the same activations into one; numerics are
+    matmul-associativity-identical and the param tree is unchanged."""
 
     num_heads: int
     dtype: Any = jnp.float32
     attn_impl: str = "full"
     seq_axis: str | None = None
     tp_axis: str | None = None
+    fused_qkv: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -72,11 +109,26 @@ class MultiHeadAttention(nn.Module):
                                  f"{self.tp_axis} axis size {tp}")
             heads = self.num_heads // tp
             x = region_input(x, self.tp_axis)
-        dense = partial(nn.DenseGeneral, dtype=self.dtype,
-                        features=(heads, head_dim), axis=-1)
-        q = dense(name="query")(x)
-        k = dense(name="key")(x)
-        v = dense(name="value")(x)
+        if self.fused_qkv:
+            wq, bq = _ProjParams(d, heads, head_dim, name="query")()
+            wk, bk = _ProjParams(d, heads, head_dim, name="key")()
+            wv, bv = _ProjParams(d, heads, head_dim, name="value")()
+            w = jnp.concatenate(
+                [t.reshape(d, heads * head_dim) for t in (wq, wk, wv)],
+                axis=1).astype(self.dtype)
+            bias = jnp.concatenate(
+                [t.reshape(heads * head_dim) for t in (bq, bk, bv)]
+            ).astype(self.dtype)
+            qkv = x @ w + bias
+            q, k, v = (qkv[..., i * heads * head_dim:
+                           (i + 1) * heads * head_dim]
+                       .reshape(b, n, heads, head_dim) for i in range(3))
+        else:
+            dense = partial(nn.DenseGeneral, dtype=self.dtype,
+                            features=(heads, head_dim), axis=-1)
+            q = dense(name="query")(x)
+            k = dense(name="key")(x)
+            v = dense(name="value")(x)
         y = _make_attn_fn(self.attn_impl, self.seq_axis)(q, k, v)
         if self.tp_axis is not None:
             return _RowDenseGeneral(d, self.tp_axis, dtype=self.dtype,
@@ -99,6 +151,7 @@ class EncoderBlock(nn.Module):
     attn_impl: str = "full"
     seq_axis: str | None = None
     tp_axis: str | None = None
+    fused_qkv: bool = False
     moe: bool = False
     num_experts: int = 8
     capacity_factor: float = 1.25
@@ -113,6 +166,7 @@ class EncoderBlock(nn.Module):
         x = x + MultiHeadAttention(
             self.num_heads, dtype=self.dtype, attn_impl=self.attn_impl,
             seq_axis=self.seq_axis, tp_axis=self.tp_axis,
+            fused_qkv=self.fused_qkv,
             name="self_attention")(y)
         y = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="ln_2")(x)
         if self.moe:
@@ -182,6 +236,13 @@ class VisionTransformer(nn.Module):
     moe_top_k: int = 1            # 1 = Switch; 2 = GShard top-2
     expert_axis: str | None = None  # mesh axis for expert parallelism
     remat: bool = False  # jax.checkpoint each block (recompute on bwd)
+    fused_qkv: bool = False  # one QKV GEMM (same param tree; see MHA)
+    register_tokens: int = 0  # extra learned tokens appended after the
+    # patch (+cls) tokens and EXCLUDED from readout. Two uses: (a) the
+    # DINOv2-style registers regularizer, and (b) a TPU tiling lever —
+    # 224px ViT-B/16 has 197 tokens, a 2x(128-lane) MXU tile wants 256;
+    # 59 registers fill the padded lanes with real (if redundant) work
+    # instead of XLA pad-and-mask.
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -203,6 +264,21 @@ class VisionTransformer(nn.Module):
                          nn.initializers.normal(stddev=0.02),
                          (1, n_tokens, d), jnp.float32)
         x = x + pos.astype(self.dtype)
+        n_real = n_tokens  # readout tokens (registers excluded below)
+        if self.register_tokens:
+            if self.seq_axis is not None:
+                raise ValueError(
+                    "register_tokens and sequence parallelism don't "
+                    "compose (registers would break the even token "
+                    "split over the mesh axis)")
+            reg = self.param("register_tokens",
+                             nn.initializers.normal(stddev=0.02),
+                             (1, self.register_tokens, d), jnp.float32)
+            x = jnp.concatenate(
+                [x, jnp.broadcast_to(reg.astype(self.dtype),
+                                     (b, self.register_tokens, d))],
+                axis=1)
+            n_tokens += self.register_tokens
 
         if self.seq_axis is not None:
             # Static under shard_map — derived from the live mesh, so it can
@@ -234,6 +310,7 @@ class VisionTransformer(nn.Module):
             body = partial(block_cls, self.num_heads, self.mlp_dim,
                            dtype=self.dtype, attn_impl=self.attn_impl,
                            seq_axis=self.seq_axis, tp_axis=self.tp_axis,
+                           fused_qkv=self.fused_qkv,
                            name="block", **moe_kw)
             x = Pipeline(body=body, num_layers=self.num_layers,
                          pipe_axis=self.pipe_axis,
@@ -245,6 +322,7 @@ class VisionTransformer(nn.Module):
                 x = block_cls(self.num_heads, self.mlp_dim,
                               dtype=self.dtype, attn_impl=self.attn_impl,
                               seq_axis=self.seq_axis, tp_axis=self.tp_axis,
+                              fused_qkv=self.fused_qkv,
                               moe=moe, num_experts=self.num_experts,
                               capacity_factor=self.capacity_factor,
                               moe_groups=self.moe_groups,
@@ -255,7 +333,8 @@ class VisionTransformer(nn.Module):
         if use_cls:
             pooled = x[:, 0]
         else:
-            pooled = jnp.mean(x, axis=1)
+            # Registers (if any) sit at the end; GAP pools real tokens.
+            pooled = jnp.mean(x[:, :n_real], axis=1)
             if self.seq_axis is not None:
                 # equal chunks ⇒ global token mean = pmean of local means
                 pooled = lax.pmean(pooled, self.seq_axis)

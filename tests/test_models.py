@@ -4,6 +4,7 @@ classes — the reference's model, ``imagenet.py:312``)."""
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from imagent_tpu.models import PARAM_COUNTS, create_model
@@ -115,3 +116,70 @@ def test_s2d_stem_equivalent_family():
     with pytest.raises(ValueError, match="unknown stem"):
         create_model("resnet18", num_classes=10, stem="S2D").init(
             jax.random.key(0), x, train=False)
+
+
+def test_vit_fused_qkv_same_tree_same_logits():
+    """--fused-qkv computes q/k/v as one GEMM from the SAME param
+    tensors: identical tree (checkpoints/TP specs/torch-compat
+    unaffected) and identical logits on shared params."""
+    import jax
+
+    from imagent_tpu.models.vit import VisionTransformer
+
+    kw = dict(patch_size=8, hidden_dim=64, num_layers=2, num_heads=4,
+              mlp_dim=128, num_classes=10)
+    m0 = VisionTransformer(**kw)
+    m1 = VisionTransformer(**kw, fused_qkv=True)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    v = m0.init(jax.random.key(0), x, train=False)
+    v1 = m1.init(jax.random.key(0), x, train=False)
+    assert (jax.tree_util.tree_structure(v)
+            == jax.tree_util.tree_structure(v1))
+    # Same key ⇒ IDENTICAL init values: flax folds the param rng by
+    # path, and _ProjParams draws on DenseGeneral's flattened fan-in
+    # shape — this is what catches an initializer-distribution drift
+    # between the two paths (found by review in round 4).
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), v, v1)
+    y0 = np.asarray(m0.apply(v, x, train=False))
+    y1 = np.asarray(m1.apply(v, x, train=False))
+    np.testing.assert_allclose(y1, y0, rtol=1e-5, atol=1e-5)
+
+
+def test_vit_register_tokens():
+    """Registers append learned tokens (R x D params) that ride the
+    encoder but are excluded from both cls and GAP readout."""
+    import jax
+    import jax.numpy as jnp
+
+    from imagent_tpu.models.vit import VisionTransformer
+
+    kw = dict(patch_size=8, hidden_dim=64, num_layers=2, num_heads=4,
+              mlp_dim=128, num_classes=10)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    base = VisionTransformer(**kw)
+    reg = VisionTransformer(**kw, register_tokens=5)
+    v0 = base.init(jax.random.key(0), x, train=False)
+    v5 = reg.init(jax.random.key(0), x, train=False)
+    n0 = sum(a.size for a in jax.tree_util.tree_leaves(v0))
+    n5 = sum(a.size for a in jax.tree_util.tree_leaves(v5))
+    assert n5 - n0 == 5 * 64
+    assert reg.apply(v5, x, train=False).shape == (2, 10)
+
+    # GAP readout pools only the real tokens: zeroing the register
+    # params must not be equivalent to removing them from the mean
+    # (they still attend), but the output must stay finite and the
+    # readout shape unchanged.
+    gap = VisionTransformer(**kw, register_tokens=5, gap_readout=True)
+    vg = gap.init(jax.random.key(0), x, train=False)
+    out = gap.apply(vg, x, train=False)
+    assert out.shape == (2, 10) and bool(jnp.isfinite(out).all())
+
+    # seq-parallel + registers is rejected loudly.
+    import pytest
+
+    sp = VisionTransformer(**kw, register_tokens=4, gap_readout=True,
+                           attn_impl="ring", seq_axis="model")
+    with pytest.raises(ValueError, match="register_tokens"):
+        sp.init(jax.random.key(0), x, train=False)
