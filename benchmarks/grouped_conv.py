@@ -40,14 +40,17 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-# ResNeXt-50 32x4d grouped-conv geometries: (H=W, width, stride) per
-# stage at 224px input, batch dimension added at measure time. width =
-# int(filters * 4 / 64) * 32; the grouped 3x3 maps width -> width.
+# ResNeXt-50 32x4d grouped-conv geometries at 224px input; width =
+# int(filters * 4 / 64) * 32, the grouped 3x3 maps width -> width.
+# (name, H=W, width): the stride-1 body geometry of each stage's
+# grouped 3x3 (the strided first-block conv has the same AI per output
+# element and 1/4 the elements — the stride-1 form is the dominant and
+# representative cost).
 STAGES = [
-    ("l1.3x3g32", 56, 128, 1),
-    ("l2.3x3g32", 28, 256, 1),
-    ("l3.3x3g32", 14, 512, 1),
-    ("l4.3x3g32", 7, 1024, 1),
+    ("l1.3x3g32", 56, 128),
+    ("l2.3x3g32", 28, 256),
+    ("l3.3x3g32", 14, 512),
+    ("l4.3x3g32", 7, 1024),
 ]
 GROUPS = 32
 
@@ -106,7 +109,7 @@ def measure_stage(name: str, hw: int, width: int, batch: int,
         for ky in (-1, 0, 1):
             for kx in (-1, 0, 1):
                 taps.append(jnp.roll(yg, (-ky, -kx), axis=(1, 2)))
-        t = jnp.stack(taps, axis=-2)  # n h w g 9 cg... wait ordering
+        t = jnp.stack(taps, axis=-2)  # (n, h, w, G, 9, cg)
         out = jnp.einsum("nhwgtc,tgcd->nhwgd",
                          t.reshape(n, h, ww_, GROUPS, 9, cg),
                          w_g.reshape(9, cg, GROUPS, cg).transpose(
@@ -162,7 +165,7 @@ def main() -> int:
     print(json.dumps({"hbm_copy_gbs": round(hbm, 1),
                       "mxu_matmul_tflops": round(mxu, 1),
                       "batch": batch}))
-    for name, hw, width, stride in STAGES:
+    for name, hw, width in STAGES:
         print(json.dumps(measure_stage(name, hw, width, batch, hbm, mxu)))
     return 0
 

@@ -43,18 +43,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def _timed(f, *args, reps=10, windows=3):
-    """Median-of-windows chained timing with a hard device fetch."""
-    out = f(*args)
-    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])  # compile
-    best = []
-    for _ in range(windows):
+def _timed(f, *args, lo=3, hi=13, pairs=3):
+    """Paired-window differencing (the bench.py estimator): each sample
+    is (T(hi) - T(lo)) / (hi - lo), cancelling the fixed per-window
+    dispatch/fetch cost — the derived lines below subtract two phase
+    times, so the absolute numbers must be cleaner than the few-ms
+    deltas they resolve."""
+    def window(reps):
         t0 = time.perf_counter()
         for _ in range(reps):
             out = f(*args)
         np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
-        best.append((time.perf_counter() - t0) / reps)
-    return float(np.median(best))
+        return time.perf_counter() - t0
+
+    f(*args)  # compile
+    window(lo)
+    samples = [(window(hi) - window(lo)) / (hi - lo) for _ in range(pairs)]
+    return float(np.median(samples))
 
 
 def main() -> int:
@@ -130,22 +135,19 @@ def main() -> int:
     st = state
     lr = np.float32(0.1)
 
-    def full(s):
-        s2, m = step(s, gi, gl, lr)
-        return s2, m
-
-    # state-chained full step
-    for _ in range(3):
-        st, m = step(st, gi, gl, lr)
-    np.asarray(m)
-    best = []
-    for _ in range(3):
+    # Full production step: state-chained paired-window differencing
+    # (the step donates its state, so the chain threads st through).
+    def full_window(reps):
+        nonlocal st
         t0 = time.perf_counter()
-        for _ in range(10):
+        for _ in range(reps):
             st, m = step(st, gi, gl, lr)
         np.asarray(m)
-        best.append((time.perf_counter() - t0) / 10)
-    out["full_step_ms"] = round(float(np.median(best)) * 1e3, 2)
+        return time.perf_counter() - t0
+
+    full_window(3)  # compile + warm
+    samples = [(full_window(13) - full_window(3)) / 10 for _ in range(3)]
+    out["full_step_ms"] = round(float(np.median(samples)) * 1e3, 2)
 
     out["derived"] = {
         "bwd_only_ms": round(out["grad_train_ms"] - out["fwd_train_ms"],

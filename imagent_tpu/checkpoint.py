@@ -201,61 +201,78 @@ def restore(ckpt_dir: str, name: str,
     state_abstract = jax.tree.map(_abstract, target)
 
     # --ema-decay toggled between the writing run and this one changes
-    # the TrainState tree structure (ema_params None <-> params-shaped).
-    # Rather than fail every restore probe with a misleading arch error,
-    # retry with the EMA presence flipped and reconcile afterwards:
-    # missing on disk -> initialize the average from the restored params;
-    # present on disk but off now -> drop the buffers.
-    target_has_ema = getattr(target, "ema_params", None) is not None
+    # the TrainState tree structure: ema_params (and, since round 4,
+    # ema_batch_stats) exist only when EMA is on, and pre-round-4 EMA
+    # checkpoints carry ema_params WITHOUT ema_batch_stats. The valid
+    # presence combos are (ep, eb) ∈ {(F, F), (T, F) legacy, (T, T)}.
+    # Rather than fail every restore probe with a misleading arch
+    # error, adapt the abstract to the on-disk combo and reconcile:
+    # buffers missing on disk initialize from the restored live values;
+    # surplus on-disk buffers are dropped.
+    tgt_ep = getattr(target, "ema_params", None) is not None
+    tgt_eb = getattr(target, "ema_batch_stats", None) is not None
+    _COMBOS = ((False, False), (True, False), (True, True))
+    # Target combo first: the common case costs exactly one restore.
+    combo_order = ([(tgt_ep, tgt_eb)]
+                   + [c for c in _COMBOS if c != (tgt_ep, tgt_eb)])
 
-    def _ema_flipped(abstract):
-        if target_has_ema:
-            return abstract.replace(ema_params=None)
-        # EMA leaves mirror the params exactly (shape/dtype/sharding).
-        return abstract.replace(ema_params=abstract.params)
+    def _with_ema(abstract, ep: bool, eb: bool):
+        # EMA leaves mirror their live twin exactly
+        # (shape/dtype/sharding).
+        a = abstract.replace(ema_params=abstract.params if ep else None)
+        if hasattr(a, "ema_batch_stats"):
+            a = a.replace(ema_batch_stats=a.batch_stats if eb else None)
+        return a
 
-    def _reconcile_ema(state):
-        """Fix up a state restored through the EMA-flipped abstract."""
-        if target_has_ema:
+    def _reconcile_ema(state, ep: bool, eb: bool):
+        """Adapt a state restored with on-disk presence (ep, eb) to the
+        target's (tgt_ep, tgt_eb)."""
+        import jax.numpy as jnp
+        if tgt_ep and not ep:
             print("NOTE: checkpoint has no EMA buffers (written with "
                   "--ema-decay off); initializing the average from the "
                   "restored params", flush=True)
-            import jax.numpy as jnp
-            return state.replace(
+            state = state.replace(
                 ema_params=jax.tree.map(jnp.array, state.params))
-        print("NOTE: dropping the checkpoint's EMA buffers "
-              "(--ema-decay is off for this run)", flush=True)
-        return state.replace(ema_params=None)
+        elif ep and not tgt_ep:
+            print("NOTE: dropping the checkpoint's EMA buffers "
+                  "(--ema-decay is off for this run)", flush=True)
+            state = state.replace(ema_params=None)
+        if tgt_eb and not eb:
+            print("NOTE: checkpoint has no EMA BatchNorm-stat buffers "
+                  "(pre-round-4 EMA layout); initializing them from "
+                  "the restored running stats", flush=True)
+            state = state.replace(
+                ema_batch_stats=jax.tree.map(jnp.array,
+                                             state.batch_stats))
+        elif eb and not tgt_eb and hasattr(state, "ema_batch_stats"):
+            state = state.replace(ema_batch_stats=None)
+        return state
 
-    def _restore_state(abstract_state, meta_fields, flip=None):
-        """Restore with the given state abstract. ``flip``: True ⇒ the
-        on-disk EMA presence is known to differ (use the flipped
-        abstract, reconcile after); False ⇒ known to match; None ⇒
-        unknown (metadata unreadable) — try as-is, fall back to flipped.
-        Returns (state, meta_tree)."""
+    def _restore_state(abstract_state, meta_fields, combo=None):
+        """Restore with the given state abstract. ``combo``: the
+        on-disk (ema_params, ema_batch_stats) presence when known from
+        metadata; None ⇒ unknown (metadata unreadable) — probe the
+        combos, target's first. Returns (state, meta_tree)."""
         mk = lambda sa: {
             "state": sa,
             "meta": {k: jax.ShapeDtypeStruct((), dtype)
                      for k, dtype, _ in meta_fields},
         }
-        if flip is None:
+        order = [combo] if combo is not None else combo_order
+        first_err: Exception | None = None
+        for c in order:
             try:
-                tree = ckptr.restore(path, mk(abstract_state))
-                return tree["state"], tree["meta"]
-            except Exception as as_is_err:
-                try:
-                    tree = ckptr.restore(
-                        path, mk(_ema_flipped(abstract_state)))
-                except Exception:
-                    # Both failed: the as-is error is the informative
-                    # one (the flipped message adds spurious ema noise).
-                    raise as_is_err
-                return _reconcile_ema(tree["state"]), tree["meta"]
-        if flip:
-            tree = ckptr.restore(path, mk(_ema_flipped(abstract_state)))
-            return _reconcile_ema(tree["state"]), tree["meta"]
-        tree = ckptr.restore(path, mk(abstract_state))
-        return tree["state"], tree["meta"]
+                tree = ckptr.restore(path, mk(_with_ema(abstract_state,
+                                                        *c)))
+            except Exception as e:
+                # The target-combo error is the informative one for a
+                # genuine arch mismatch (the variants add ema noise).
+                if first_err is None:
+                    first_err = e
+                continue
+            return _reconcile_ema(tree["state"], *c), tree["meta"]
+        raise first_err
 
     def _zero1_resize(abstract, ondisk_state):
         """Cross-topology ZeRO-1: the flat momentum buffer is padded to
@@ -310,16 +327,17 @@ def restore(ckpt_dir: str, name: str,
     if isinstance(ondisk, dict) and "meta" in ondisk and "state" in ondisk:
         present = set(ondisk["meta"])
         fields = tuple(f for f in _META_FIELDS if f[0] in present)
-        # The metadata already reveals whether ema_params was saved (a
+        # The metadata already reveals which EMA buffers were saved (a
         # None subtree leaves no entry) — pick the right abstract
-        # deterministically; blind double-probing is only for the
+        # deterministically; blind probing is only for the
         # metadata-unreadable path.
-        flip = None
+        combo = None
         sa, zero1_len = state_abstract, None
         if isinstance(ondisk["state"], dict):
-            flip = bool(ondisk["state"].get("ema_params")) != target_has_ema
+            combo = (bool(ondisk["state"].get("ema_params")),
+                     bool(ondisk["state"].get("ema_batch_stats")))
             sa, zero1_len = _zero1_resize(state_abstract, ondisk["state"])
-        state, meta_tree = _restore_state(sa, fields, flip)
+        state, meta_tree = _restore_state(sa, fields, combo)
         if zero1_len is not None:
             state = _repad_zero1(state, zero1_len)
         meta: dict[str, Any] = {k: default
@@ -327,15 +345,22 @@ def restore(ckpt_dir: str, name: str,
         meta.update({k: v.item() for k, v in meta_tree.items()})
         return state, meta
 
-    if isinstance(ondisk, dict):  # flat round-1 layout, definitively
-        try:
-            state = ckptr.restore(path, state_abstract)
-        except Exception as as_is_err:
+    def _restore_flat():
+        """Round-1 flat-TrainState layout, with the same EMA-combo
+        adaptation (target combo first; its error is the one raised)."""
+        first_err: Exception | None = None
+        for c in combo_order:
             try:
-                state = _reconcile_ema(
-                    ckptr.restore(path, _ema_flipped(state_abstract)))
-            except Exception:
-                raise as_is_err
+                raw = ckptr.restore(path, _with_ema(state_abstract, *c))
+            except Exception as e:
+                if first_err is None:
+                    first_err = e
+                continue
+            return _reconcile_ema(raw, *c)
+        raise first_err
+
+    if isinstance(ondisk, dict):  # flat round-1 layout, definitively
+        state = _restore_flat()
         print(f"NOTE: restored legacy-layout checkpoint {path} "
               "(pre-{state,meta} format); re-saving will migrate it",
               flush=True)
@@ -351,15 +376,16 @@ def restore(ckpt_dir: str, name: str,
     # informative one for a genuine arch mismatch) and summarizes the
     # rest by type.
     probe_errs: list[Exception] = []
-    # As-is prefixes first, EMA-flipped only if every as-is probe failed
-    # (EMA presence is constant across prefixes — interleaving the flip
-    # per-prefix would double the cost of this already-expensive path).
-    for flip in (False, True):
+    # Target-combo prefixes first; other EMA combos only if every
+    # target-combo probe failed (EMA presence is constant across
+    # prefixes — interleaving per-prefix would multiply the cost of
+    # this already-expensive, error-path-only fallback).
+    for combo in combo_order:
         for n_meta in range(len(_META_FIELDS), 3, -1):
             fields = _META_FIELDS[:n_meta]
             try:
                 state, meta_tree = _restore_state(
-                    state_abstract, fields, flip)
+                    state_abstract, fields, combo)
             except Exception as e:
                 probe_errs.append(e)
                 continue
@@ -367,14 +393,7 @@ def restore(ckpt_dir: str, name: str,
             meta.update({k: v.item() for k, v in meta_tree.items()})
             return state, meta
     try:
-        try:
-            state = ckptr.restore(path, state_abstract)
-        except Exception as as_is_err:
-            try:
-                state = _reconcile_ema(
-                    ckptr.restore(path, _ema_flipped(state_abstract)))
-            except Exception:
-                raise as_is_err
+        state = _restore_flat()
     except Exception as e:
         probe_errs.append(e)
         summary = "; ".join(

@@ -178,11 +178,16 @@ def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
              epoch: int) -> tuple[dict, float]:
     """Validation epoch (reference ``validate()``, ``imagenet.py:166-210``),
     exact under padding via the mask. With --ema-decay the evaluated
-    weights are the EMA (``model.eval()`` on the averaged model); the
-    tree structure is unchanged, so the compiled step and its shardings
-    are reused as-is."""
+    weights are the EMA (``model.eval()`` on the averaged model) AND so
+    are the BatchNorm stats — the live running stats track the LIVE
+    params' activation distribution, so pairing them with EMA params
+    diverges when the params drift fast (train.TrainState docstring);
+    the tree structure is unchanged, so the compiled step and its
+    shardings are reused as-is."""
     if cfg.ema_decay > 0.0 and state.ema_params is not None:
         state = state.replace(params=state.ema_params)
+        if state.ema_batch_stats is not None:
+            state = state.replace(batch_stats=state.ema_batch_stats)
     t0 = time.time()
     metric_buf = []
     for images, labels, mask in device_prefetch(
@@ -424,8 +429,11 @@ def run(cfg: Config, stop_check=None) -> dict:
     if cfg.ema_decay > 0.0:
         # Fresh buffers (not aliases) — the train step donates the state,
         # and a leaf may not be donated through two tree slots at once.
+        # BN stats are averaged too (timm ModelEmaV2 buffer semantics;
+        # see TrainState docstring for the failure mode otherwise).
         state = state.replace(
-            ema_params=jax.tree.map(jnp.array, state.params))
+            ema_params=jax.tree.map(jnp.array, state.params),
+            ema_batch_stats=jax.tree.map(jnp.array, state.batch_stats))
     if cfg.zero1:
         from imagent_tpu.parallel import zero as zero_lib
         state = state.replace(

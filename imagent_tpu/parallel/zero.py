@@ -64,6 +64,8 @@ def zero1_state_specs(state) -> "object":
         opt_state=P(DATA_AXIS),
         ema_params=None if state.ema_params is None else
         jax.tree.map(lambda _: P(), state.ema_params),
+        ema_batch_stats=None if state.ema_batch_stats is None else
+        jax.tree.map(lambda _: P(), state.ema_batch_stats),
     )
 
 

@@ -53,16 +53,23 @@ class TrainState(flax.struct.PyTreeNode):
     """Replicated training state: the DDP-equivalent bundle of model
     replica + optimizer slots (``imagenet.py:312-325``).
 
-    ``ema_params`` (None when --ema-decay is off) is an exponential
-    moving average of ``params`` maintained inside the train step;
-    evaluation runs on it when enabled (engine.py). BatchNorm statistics
-    are not separately averaged — they are already running averages."""
+    ``ema_params`` / ``ema_batch_stats`` (None when --ema-decay is off)
+    are exponential moving averages of ``params`` and of the BatchNorm
+    running stats, maintained inside the train step; evaluation runs on
+    them when enabled (engine.py). The stats are averaged TOO (timm
+    ModelEmaV2 semantics, which decays all buffers): the live running
+    stats track the LIVE params' activation distribution, so evaluating
+    EMA params against them diverges whenever the params move fast
+    relative to the EMA horizon — observed catastrophically on the
+    round-4 run of record (val loss 3817 mid-run at decay 0.999,
+    docs/runs/imagenet_shaped_tpu.log) before this field existed."""
 
     step: jnp.ndarray
     params: Any
     batch_stats: Any
     opt_state: Any
     ema_params: Any = None
+    ema_batch_stats: Any = None
 
 
 def make_optimizer(momentum: float = 0.9,
@@ -165,8 +172,10 @@ def state_partition_specs(state: TrainState, params_specs) -> TrainState:
         params=params_specs,
         batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
         opt_state=opt_specs,
-        # EMA leaves mirror their parameter's layout exactly.
+        # EMA leaves mirror their live twin's layout exactly.
         ema_params=None if state.ema_params is None else params_specs,
+        ema_batch_stats=None if state.ema_batch_stats is None else
+        jax.tree.map(lambda _: P(), state.ema_batch_stats),
     )
 
 
@@ -402,6 +411,7 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         metrics = lax.psum(local, DATA_AXIS)
 
         new_ema = state.ema_params
+        new_ema_bs = state.ema_batch_stats
         if ema_decay > 0.0:  # timm ModelEma semantics: no bias correction
             if state.ema_params is None:
                 raise ValueError(
@@ -412,11 +422,15 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
             new_ema = jax.tree.map(
                 lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
                 state.ema_params, new_params)
+            if state.ema_batch_stats is not None:  # None: legacy resume
+                new_ema_bs = jax.tree.map(
+                    lambda e, s: ema_decay * e + (1.0 - ema_decay) * s,
+                    state.ema_batch_stats, new_bs)
 
         new_state = state.replace(
             step=state.step + 1, params=new_params,
             batch_stats=new_bs, opt_state=new_opt_state,
-            ema_params=new_ema)
+            ema_params=new_ema, ema_batch_stats=new_ema_bs)
         return new_state, metrics
 
     st = state_specs if state_specs is not None else P()
@@ -508,6 +522,7 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
         new_params = optax.apply_updates(
             state.params, jax.tree.map(lambda u: -lr * u, updates))
         new_ema = state.ema_params
+        new_ema_bs = state.ema_batch_stats
         if ema_decay > 0.0:
             if state.ema_params is None:
                 raise ValueError(
@@ -518,10 +533,15 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
             new_ema = jax.tree.map(
                 lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
                 state.ema_params, new_params)
+            if state.ema_batch_stats is not None:  # None: legacy resume
+                new_ema_bs = jax.tree.map(
+                    lambda e, s: ema_decay * e + (1.0 - ema_decay) * s,
+                    state.ema_batch_stats, new_bs)
         return state.replace(step=state.step + 1, params=new_params,
                              batch_stats=new_bs,
                              opt_state=new_opt_state,
-                             ema_params=new_ema), metrics
+                             ema_params=new_ema,
+                             ema_batch_stats=new_ema_bs), metrics
 
     state_sh = shardings_from_specs(mesh, state_specs)
     batch_sh = NamedSharding(mesh, P(DATA_AXIS))

@@ -161,3 +161,82 @@ def test_engine_enables_ema_mid_run(tmp_path):
     assert np.isfinite(on["final_val"]["loss"])
     off = run(Config(**{**base, "epochs": 3}, resume=True))
     assert np.isfinite(off["final_val"]["loss"])
+
+
+def test_ema_tracks_batch_stats(mesh8):
+    """Round-4 fix: the EMA averages BatchNorm running stats too (timm
+    ModelEmaV2 buffer semantics). Evaluating EMA params against the
+    LIVE stats diverged on the run of record (val loss 3817 at decay
+    0.999 — the stats tracked params ~10 epochs ahead of the average).
+    One step must give ema_bs' = d*ema_bs + (1-d)*bs'."""
+    import jax.numpy as jnp
+
+    from imagent_tpu.models import create_model
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step,
+        replicate_state,
+    )
+
+    d = 0.9
+    model = create_model("resnet18", num_classes=4)
+    opt = make_optimizer()
+    state = create_train_state(model, jax.random.key(0), 16, opt)
+    state = state.replace(
+        ema_params=jax.tree.map(jnp.array, state.params),
+        ema_batch_stats=jax.tree.map(jnp.array, state.batch_stats))
+    init_bs = jax.device_get(state.batch_stats)
+    state = replicate_state(state, mesh8)
+    step = make_train_step(model, opt, mesh8, ema_decay=d)
+
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(16, 16, 16, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    from imagent_tpu.train import shard_batch
+    gi, gl = shard_batch(mesh8, images, labels)
+    new, _ = step(state, gi, gl, np.float32(0.1))
+
+    got = jax.device_get(new.ema_batch_stats)
+    live = jax.device_get(new.batch_stats)
+    jax.tree.map(
+        lambda e, i, s: np.testing.assert_allclose(
+            e, d * i + (1 - d) * s, rtol=1e-5, atol=1e-7),
+        got, init_bs, live)
+
+
+def test_legacy_ema_checkpoint_gains_stat_buffers(tmp_path):
+    """A pre-round-4 EMA checkpoint (ema_params but NO ema_batch_stats)
+    must restore into the new layout with the stat average initialized
+    from the restored running stats — not fail the probe."""
+    import jax.numpy as jnp
+
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu.cluster import make_mesh
+    from imagent_tpu.models import create_model
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, replicate_state,
+    )
+
+    mesh = make_mesh(model_parallel=1)
+    base = create_train_state(create_model("resnet18", num_classes=4),
+                              jax.random.key(0), 16, make_optimizer())
+    legacy = replicate_state(base.replace(
+        ema_params=jax.tree.map(lambda p: jnp.array(p) * 0.5,
+                                base.params)), mesh)
+    assert legacy.ema_batch_stats is None
+    ckpt_lib.save(str(tmp_path), "last", legacy, {"epoch": 3})
+
+    target = replicate_state(base.replace(
+        ema_params=jax.tree.map(jnp.array, base.params),
+        ema_batch_stats=jax.tree.map(jnp.array, base.batch_stats)), mesh)
+    got, meta = ckpt_lib.restore(str(tmp_path), "last", target)
+    assert meta["epoch"] == 3
+    assert got.ema_batch_stats is not None
+    jax.tree.map(
+        lambda e, s: np.testing.assert_array_equal(
+            jax.device_get(e), jax.device_get(s)),
+        got.ema_batch_stats, got.batch_stats)
+    # And the params average is the LEGACY one (0.5x), not re-initialized.
+    jax.tree.map(
+        lambda e, p: np.testing.assert_allclose(
+            jax.device_get(e), jax.device_get(p) * 0.5, rtol=1e-6),
+        got.ema_params, got.params)
