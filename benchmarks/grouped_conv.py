@@ -55,8 +55,12 @@ STAGES = [
 GROUPS = 32
 
 
-def _timed_chain(fn, x, reps_lo=4, reps_hi=24, pairs=3):
+def _timed_chain(fn, x, reps_lo=None, reps_hi=None, pairs=3):
     """Median per-iteration time via two chained-loop lengths."""
+    if reps_lo is None:
+        reps_lo = int(os.environ.get("GC_LO", "4"))
+    if reps_hi is None:
+        reps_hi = int(os.environ.get("GC_HI", "24"))
     import jax
 
     @partial(jax.jit, static_argnums=(1,))
@@ -165,7 +169,10 @@ def main() -> int:
     print(json.dumps({"hbm_copy_gbs": round(hbm, 1),
                       "mxu_matmul_tflops": round(mxu, 1),
                       "batch": batch}))
+    only = os.environ.get("GC_STAGE")
     for name, hw, width in STAGES:
+        if only and only not in name:
+            continue
         print(json.dumps(measure_stage(name, hw, width, batch, hbm, mxu)))
     return 0
 

@@ -61,8 +61,9 @@ class TrainState(flax.struct.PyTreeNode):
     stats track the LIVE params' activation distribution, so evaluating
     EMA params against them diverges whenever the params move fast
     relative to the EMA horizon — observed catastrophically on the
-    round-4 run of record (val loss 3817 mid-run at decay 0.999,
-    docs/runs/imagenet_shaped_tpu.log) before this field existed."""
+    round-4 draft run (val loss 3817 mid-run at decay 0.999,
+    docs/runs/imagenet_shaped_r4draft_tpu.log) before this field
+    existed."""
 
     step: jnp.ndarray
     params: Any
