@@ -1,3 +1,4 @@
 from imagent_tpu.compat.torch_weights import (  # noqa: F401
-    resnet_from_torch, resnet_to_torch, vit_from_torch,
+    convnext_from_torch, convnext_to_torch, resnet_from_torch,
+    resnet_to_torch, vit_from_torch,
 )

@@ -193,3 +193,103 @@ def resnet_to_torch(params: dict, batch_stats: dict,
     sd["fc.weight"] = _linear_inv(params["fc"]["kernel"])
     sd["fc.bias"] = np.asarray(params["fc"]["bias"])
     return sd
+
+
+def convnext_from_torch(state_dict: dict) -> dict:
+    """torchvision ConvNeXt (convnext_tiny/small/base/large) state_dict
+    → params tree matching ``models/convnext.py``. Structure is inferred
+    from the keys (torchvision's ``features`` indices: 0 = stem,
+    odd = block stages, even = LayerNorm+conv downsamples; CNBlock
+    submodule indices: block.0 dwconv, block.2 LayerNorm, block.3/5 the
+    two Linears, plus the ``layer_scale`` parameter). ConvNeXt has no
+    BatchNorm, so there is no batch_stats tree to return."""
+    sd = _strip_module(state_dict)
+    params: dict = {
+        "stem_conv": {"kernel": _conv(sd["features.0.0.weight"]),
+                      "bias": sd["features.0.0.bias"]},
+        "stem_norm": {"scale": sd["features.0.1.weight"],
+                      "bias": sd["features.0.1.bias"]},
+        "head_norm": {"scale": sd["classifier.0.weight"],
+                      "bias": sd["classifier.0.bias"]},
+        "head": {"kernel": _linear(sd["classifier.2.weight"]),
+                 "bias": sd["classifier.2.bias"]},
+    }
+    stage = 0
+    f = 1  # features index: odd entries are stages, even are downsamples
+    while f"features.{f}.0.block.0.weight" in sd:
+        j = 0
+        while f"features.{f}.{j}.block.0.weight" in sd:
+            src = f"features.{f}.{j}"
+            params[f"stage{stage}_block{j}"] = {
+                "dwconv": {"kernel": _conv(sd[f"{src}.block.0.weight"]),
+                           "bias": sd[f"{src}.block.0.bias"]},
+                "norm": {"scale": sd[f"{src}.block.2.weight"],
+                         "bias": sd[f"{src}.block.2.bias"]},
+                "pwconv1": {"kernel": _linear(sd[f"{src}.block.3.weight"]),
+                            "bias": sd[f"{src}.block.3.bias"]},
+                "pwconv2": {"kernel": _linear(sd[f"{src}.block.5.weight"]),
+                            "bias": sd[f"{src}.block.5.bias"]},
+                "layer_scale": np.asarray(
+                    sd[f"{src}.layer_scale"]).reshape(-1),
+            }
+            j += 1
+        stage += 1
+        f += 1
+        if f"features.{f}.0.weight" in sd:  # downsample: LN then conv
+            params[f"downsample{stage}_norm"] = {
+                "scale": sd[f"features.{f}.0.weight"],
+                "bias": sd[f"features.{f}.0.bias"]}
+            params[f"downsample{stage}_conv"] = {
+                "kernel": _conv(sd[f"features.{f}.1.weight"]),
+                "bias": sd[f"features.{f}.1.bias"]}
+            f += 1
+    return params
+
+
+def convnext_to_torch(params: dict) -> dict:
+    """The inverse of ``convnext_from_torch``: our params tree → a
+    torchvision-named ConvNeXt ``state_dict`` (numpy values). Round-trip
+    is bit-exact (tests/test_torch_compat.py)."""
+    sd: dict = {
+        "features.0.0.weight": _conv_inv(params["stem_conv"]["kernel"]),
+        "features.0.0.bias": np.asarray(params["stem_conv"]["bias"]),
+        "features.0.1.weight": np.asarray(params["stem_norm"]["scale"]),
+        "features.0.1.bias": np.asarray(params["stem_norm"]["bias"]),
+        "classifier.0.weight": np.asarray(params["head_norm"]["scale"]),
+        "classifier.0.bias": np.asarray(params["head_norm"]["bias"]),
+        "classifier.2.weight": _linear_inv(params["head"]["kernel"]),
+        "classifier.2.bias": np.asarray(params["head"]["bias"]),
+    }
+    stage = 0
+    f = 1
+    while f"stage{stage}_block0" in params:
+        j = 0
+        while f"stage{stage}_block{j}" in params:
+            b = params[f"stage{stage}_block{j}"]
+            dst = f"features.{f}.{j}"
+            sd[f"{dst}.block.0.weight"] = _conv_inv(b["dwconv"]["kernel"])
+            sd[f"{dst}.block.0.bias"] = np.asarray(b["dwconv"]["bias"])
+            sd[f"{dst}.block.2.weight"] = np.asarray(b["norm"]["scale"])
+            sd[f"{dst}.block.2.bias"] = np.asarray(b["norm"]["bias"])
+            sd[f"{dst}.block.3.weight"] = _linear_inv(
+                b["pwconv1"]["kernel"])
+            sd[f"{dst}.block.3.bias"] = np.asarray(b["pwconv1"]["bias"])
+            sd[f"{dst}.block.5.weight"] = _linear_inv(
+                b["pwconv2"]["kernel"])
+            sd[f"{dst}.block.5.bias"] = np.asarray(b["pwconv2"]["bias"])
+            sd[f"{dst}.layer_scale"] = np.asarray(
+                b["layer_scale"]).reshape(-1, 1, 1)
+            j += 1
+        stage += 1
+        f += 1
+        if f"downsample{stage}_norm" in params:
+            sd[f"features.{f}.0.weight"] = np.asarray(
+                params[f"downsample{stage}_norm"]["scale"])
+            sd[f"features.{f}.0.bias"] = np.asarray(
+                params[f"downsample{stage}_norm"]["bias"])
+            sd[f"features.{f}.1.weight"] = _conv_inv(
+                params[f"downsample{stage}_conv"]["kernel"])
+            sd[f"features.{f}.1.bias"] = np.asarray(
+                params[f"downsample{stage}_conv"]["bias"])
+            f += 1
+    return sd

@@ -53,7 +53,8 @@ class Config:
     eval_only: bool = False
     # Initialize params from a torch .pt state_dict (the reference's
     # checkpoint format, imagenet.py:392, DDP "module." prefix handled) —
-    # converted via compat/torch_weights.py. ResNet + ViT archs.
+    # converted via compat/torch_weights.py. ResNet + ViT +
+    # ConvNeXt archs.
     init_from_torch: str = ""
     # RandomResizedCrop + hflip train augmentation. The reference has NONE
     # (SURVEY §0: Resize+Normalize only, hence its 63% top-1); required for
@@ -180,7 +181,8 @@ def build_parser() -> argparse.ArgumentParser:
                             "resnet101", "resnet152", "resnext50_32x4d",
                             "resnext101_32x8d", "wide_resnet50_2",
                             "wide_resnet101_2", "vit_b16", "vit_l16",
-                            "vit_h14"])
+                            "vit_h14", "convnext_tiny", "convnext_small",
+                            "convnext_base", "convnext_large"])
     p.add_argument("--image-size", type=int, default=c.image_size)
     p.add_argument("--num-classes", type=int, default=c.num_classes)
     p.add_argument("--data-root", type=str, default=c.data_root)

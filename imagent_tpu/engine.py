@@ -213,6 +213,10 @@ def _load_torch_weights(cfg: Config, state: TrainState) -> TrainState:
         from imagent_tpu.models.vit import VIT_REGISTRY
         params = vit_from_torch(sd, VIT_REGISTRY[cfg.arch]["num_heads"])
         stats = state.batch_stats
+    elif cfg.arch.startswith("convnext"):
+        from imagent_tpu.compat import convnext_from_torch
+        params = convnext_from_torch(sd)
+        stats = state.batch_stats  # {} — ConvNeXt has no BN buffers
     else:
         from imagent_tpu.models.resnet import STAGE_SIZES
         params, stats = resnet_from_torch(sd, STAGE_SIZES[cfg.arch])
@@ -285,6 +289,10 @@ def run(cfg: Config, stop_check=None) -> dict:
             "--tensor-parallel and --seq-parallel both consume the model "
             "axis; pick one")
     use_pp = cfg.pipeline_parallel > 1
+    if use_pp and cfg.arch.startswith("convnext"):
+        raise ValueError("--pipeline-parallel covers the ViT (stage-"
+                         "sharded) and ResNet (2-stage conv) families; "
+                         "ConvNeXt runs dp/grad-accum/zero1/fsdp")
     if (use_pp and not cfg.arch.startswith("vit")
             and cfg.pipeline_parallel != 2):
         raise ValueError("ResNet pipeline parallelism is 2-stage "
@@ -317,7 +325,7 @@ def run(cfg: Config, stop_check=None) -> dict:
                          "--tensor-parallel (2-D FSDP x TP sharding) "
                          "but not with sp/pp/ep or --zero1")
     if cfg.stem != "v1":
-        if cfg.arch.startswith("vit"):
+        if cfg.arch.startswith(("vit", "convnext")):
             raise ValueError("--stem applies to the ResNet family only")
         if cfg.init_from_torch:
             raise ValueError("--init-from-torch requires --stem v1 (the "
@@ -408,7 +416,13 @@ def run(cfg: Config, stop_check=None) -> dict:
                              **vit_kw)
         init_model = model
     else:
-        kw = vit_kw if cfg.arch.startswith("vit") else {"stem": cfg.stem}
+        if cfg.arch.startswith("vit"):
+            kw = vit_kw
+        elif cfg.arch.startswith("convnext"):
+            kw = {}  # stem/vit levers don't apply; drop-path is
+            # library-level (models/convnext.py docstring)
+        else:
+            kw = {"stem": cfg.stem}
         model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
                              remat=cfg.remat, **kw)
         init_model = model

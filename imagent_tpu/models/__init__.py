@@ -24,7 +24,8 @@ def available_models() -> list[str]:
         names += sorted(vit.VIT_REGISTRY)
     except ImportError:  # pragma: no cover
         pass
-    return names
+    from imagent_tpu.models.convnext import CONVNEXT_REGISTRY
+    return names + sorted(CONVNEXT_REGISTRY)
 
 
 def create_model(arch: str, num_classes: int = 1000, bf16: bool = False,
@@ -37,6 +38,19 @@ def create_model(arch: str, num_classes: int = 1000, bf16: bool = False,
         from imagent_tpu.models import vit
         return vit.create_vit(arch, num_classes=num_classes, dtype=dtype,
                               **overrides)
+    if arch.startswith("convnext"):
+        from imagent_tpu.models.convnext import CONVNEXT_REGISTRY
+        remat = overrides.pop("remat", False)
+        drop_path = overrides.pop("drop_path_rate", 0.0)
+        if overrides:
+            raise ValueError(f"overrides {sorted(overrides)} do not apply "
+                             "to the ConvNeXt family")
+        if arch not in CONVNEXT_REGISTRY:
+            raise ValueError(
+                f"unknown arch {arch!r}; one of {available_models()}")
+        return CONVNEXT_REGISTRY[arch](num_classes=num_classes, dtype=dtype,
+                                       remat=remat,
+                                       drop_path_rate=drop_path)
     remat = overrides.pop("remat", False)  # shared flag, both families
     stem = overrides.pop("stem", "v1")
     if overrides:

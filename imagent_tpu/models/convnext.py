@@ -1,0 +1,152 @@
+"""Flax ConvNeXt family (tiny/small/base/large), NHWC, TPU-native.
+
+A modern post-reference family (the reference hard-codes resnet18,
+``imagenet.py:312``): ConvNeXt ("A ConvNet for the 2020s") replaces
+BatchNorm with LayerNorm, bottlenecks with inverted depthwise blocks,
+and ReLU with GELU. The architecture matches torchvision's
+``convnext_{tiny,small,base,large}`` exactly — stem 4x4/s4 conv +
+LayerNorm, stage transitions LayerNorm + 2x2/s2 conv, blocks
+[depthwise 7x7 -> LayerNorm -> Linear 4x -> GELU -> Linear] with a
+1e-6-initialized per-channel layer scale, eps=1e-6 everywhere,
+truncated-normal(0.02) init — so parameter counts line up with the
+published numbers:
+
+    convnext_tiny: 28,589,128    convnext_small: 50,223,688
+    convnext_base: 88,591,464    convnext_large: 197,767,336
+
+TPU-first choices: the network is channels-last END TO END — torch
+permutes NCHW<->NHWC around every block's LayerNorm/Linear pair; here
+NHWC is the native layout, LayerNorm reduces over the minor (lane)
+dimension and the two MLP projections are plain ``nn.Dense`` on the
+last axis, so no transposes exist anywhere in the program. The
+depthwise 7x7 lowers via ``feature_group_count=C`` (cg=1: pure
+HBM-streaming by the grouped-conv roofline in docs/ROOFLINE.md — its
+49 taps/channel give it ~12x the arithmetic intensity of a 3x3
+depthwise, which is why the geometry works on TPUs at all). GELU uses
+``approximate=False`` for torch-exact numerics. No BatchNorm means no
+``batch_stats`` collection: the train/eval steps already handle
+stat-less models via the ViT path, and there is nothing for EMA's
+``ema_batch_stats`` to track (params-only EMA is exact here).
+
+Stochastic depth (``drop_path_rate``, torchvision's
+``stochastic_depth_prob``) is implemented with per-block linearly
+scaled drop probability and per-sample ("row") masks, but defaults to
+0.0 and is a LIBRARY-level knob: enabling it requires passing
+``rngs={"droppath": key}`` to ``apply`` — the production train step
+(train.make_train_step) applies without rngs and therefore supports
+rate 0.0 only. ``tests/test_models.py`` covers both modes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# torchvision ConvNeXt init: trunc_normal_(std=0.02) on every conv and
+# linear weight, zero biases.
+trunc_init = nn.initializers.truncated_normal(stddev=0.02)
+
+
+class ConvNeXtBlock(nn.Module):
+    """Inverted depthwise block: dw7x7 -> LN -> 4x MLP -> layer scale.
+
+    ``drop_prob`` is this block's stochastic-depth probability (already
+    linearly scaled by the caller); when active the whole residual
+    branch is dropped per-sample and the kept samples are scaled by
+    1/(1-p) (torchvision ``stochastic_depth(mode="row")``)."""
+
+    dim: int
+    drop_prob: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = nn.Conv(self.dim, (7, 7), padding=((3, 3), (3, 3)),
+                    feature_group_count=self.dim, use_bias=True,
+                    dtype=self.dtype, kernel_init=trunc_init,
+                    name="dwconv")(x)
+        y = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="norm")(y)
+        y = nn.Dense(4 * self.dim, dtype=self.dtype,
+                     kernel_init=trunc_init, name="pwconv1")(y)
+        y = nn.gelu(y, approximate=False)
+        y = nn.Dense(self.dim, dtype=self.dtype,
+                     kernel_init=trunc_init, name="pwconv2")(y)
+        gamma = self.param("layer_scale",
+                           nn.initializers.constant(1e-6), (self.dim,))
+        y = y * gamma.astype(self.dtype)
+        if self.drop_prob > 0.0 and train:
+            keep = 1.0 - self.drop_prob
+            mask = jax.random.bernoulli(
+                self.make_rng("droppath"), keep,
+                (x.shape[0],) + (1,) * (x.ndim - 1))
+            y = y * (mask.astype(y.dtype) / keep)
+        return x + y
+
+
+class ConvNeXt(nn.Module):
+    """torchvision-plan ConvNeXt on NHWC inputs."""
+
+    depths: Sequence[int]
+    dims: Sequence[int]
+    num_classes: int = 1000
+    drop_path_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    remat: bool = False  # jax.checkpoint each block on backward
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.dims[0], (4, 4), (4, 4), padding="VALID",
+                    use_bias=True, dtype=self.dtype,
+                    kernel_init=trunc_init, name="stem_conv")(x)
+        x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype,
+                         name="stem_norm")(x)
+        block_cls = nn.remat(ConvNeXtBlock) if self.remat else ConvNeXtBlock
+        total = sum(self.depths)
+        block_id = 0
+        for i, (depth, dim) in enumerate(zip(self.depths, self.dims)):
+            if i > 0:
+                x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype,
+                                 name=f"downsample{i}_norm")(x)
+                x = nn.Conv(dim, (2, 2), (2, 2), padding="VALID",
+                            use_bias=True, dtype=self.dtype,
+                            kernel_init=trunc_init,
+                            name=f"downsample{i}_conv")(x)
+            for j in range(depth):
+                # torchvision: sd_prob = rate * block_id / (total - 1)
+                p = (self.drop_path_rate * block_id / max(total - 1, 1))
+                x = block_cls(dim=dim, drop_prob=p, dtype=self.dtype,
+                              name=f"stage{i}_block{j}")(x, train=train)
+                block_id += 1
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = x.astype(jnp.float32)  # head in fp32, like the other families
+        x = nn.LayerNorm(epsilon=1e-6, name="head_norm")(x)
+        x = nn.Dense(self.num_classes, kernel_init=trunc_init,
+                     name="head")(x)
+        return x
+
+
+# (depths, dims) per arch — torchvision's constructor table.
+CONVNEXT_DEFS = {
+    "convnext_tiny": ((3, 3, 9, 3), (96, 192, 384, 768)),
+    "convnext_small": ((3, 3, 27, 3), (96, 192, 384, 768)),
+    "convnext_base": ((3, 3, 27, 3), (128, 256, 512, 1024)),
+    "convnext_large": ((3, 3, 27, 3), (192, 384, 768, 1536)),
+}
+
+CONVNEXT_REGISTRY = {
+    name: partial(ConvNeXt, depths=depths, dims=dims)
+    for name, (depths, dims) in CONVNEXT_DEFS.items()
+}
+
+# torchvision published param counts at 1000 classes.
+CONVNEXT_PARAM_COUNTS = {
+    "convnext_tiny": 28_589_128,
+    "convnext_small": 50_223_688,
+    "convnext_base": 88_591_464,
+    "convnext_large": 197_767_336,
+}

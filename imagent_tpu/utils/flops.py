@@ -115,16 +115,44 @@ def vit_forward_flops(image_size: int, patch_size: int, hidden_dim: int,
     return flops
 
 
+def convnext_forward_flops(arch: str, image_size: int,
+                           num_classes: int = 1000) -> int:
+    """Forward FLOPs per image for models/convnext.py: stem + blocks
+    (dw7x7 + two 4x MLP projections) + downsample convs + head.
+    Multiply-add = 2 FLOPs; LayerNorm/GELU/layer-scale ignored (the
+    shared convention above).
+
+    Sanity anchor: convnext_tiny @ 224 -> 4.456 GMACs — torchvision's
+    published GFLOPS figure (tests/test_flops.py pins it)."""
+    from ..models.convnext import CONVNEXT_DEFS
+    if arch not in CONVNEXT_DEFS:
+        raise ValueError(f"unknown ConvNeXt arch {arch!r}")
+    depths, dims = CONVNEXT_DEFS[arch]
+    h = image_size // 4  # stem 4x4/s4, padding VALID
+    flops = 2 * (4 * 4 * 3) * dims[0] * h * h
+    for i, (depth, d) in enumerate(zip(depths, dims)):
+        if i > 0:
+            h = h // 2  # downsample 2x2/s2
+            flops += 2 * (2 * 2 * dims[i - 1]) * d * h * h
+        # per block: depthwise 7x7 (49 MACs/channel) + dim->4dim->dim
+        flops += depth * 2 * h * h * (49 * d + 8 * d * d)
+    flops += 2 * dims[-1] * num_classes
+    return flops
+
+
 def forward_flops(arch: str, image_size: int,
                   num_classes: int = 1000) -> int:
     """Arch-generic forward FLOPs per image for any registry model name
-    (models/__init__.py): dispatches to the ResNet or ViT counter."""
+    (models/__init__.py): dispatches to the ResNet, ViT, or ConvNeXt
+    counter."""
     if arch.startswith("vit"):
         from ..models.vit import VIT_REGISTRY
         if arch not in VIT_REGISTRY:
             raise ValueError(f"unknown ViT arch {arch!r}")
         return vit_forward_flops(image_size, num_classes=num_classes,
                                  **VIT_REGISTRY[arch])
+    if arch.startswith("convnext"):
+        return convnext_forward_flops(arch, image_size, num_classes)
     if arch not in STAGE_SIZES:
         raise ValueError(f"unknown arch {arch!r}")
     return resnet_forward_flops(arch, image_size, num_classes)

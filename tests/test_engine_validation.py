@@ -41,6 +41,10 @@ def _cfg(**kw):
           tensor_parallel=True), "--zero1"),
     (dict(fsdp=True, zero1=True), "--fsdp"),
     (dict(zero1=True, optimizer="adamw"), "--zero1 implements"),
+    (dict(arch="convnext_tiny", pipeline_parallel=2),
+     "--pipeline-parallel covers"),
+    (dict(arch="convnext_tiny", stem="s2d"),
+     "--stem applies to the ResNet family"),
 ])
 def test_invalid_combinations_rejected(kw, match):
     with pytest.raises(ValueError, match=match):

@@ -53,3 +53,25 @@ def test_chip_peak_lookup():
     assert chip_peak_bf16_tflops("TPU v5 lite") == 197.0
     assert chip_peak_bf16_tflops("TPU v4") == 275.0
     assert chip_peak_bf16_tflops("TPU imaginary") is None
+
+
+# Published ConvNeXt forward MACs at 224, 1000 classes (torchvision).
+PUBLISHED_CONVNEXT_GMACS = {
+    "convnext_tiny": 4.456,
+    "convnext_small": 8.684,
+    "convnext_base": 15.355,
+    "convnext_large": 34.361,
+}
+
+
+@pytest.mark.parametrize("arch", sorted(PUBLISHED_CONVNEXT_GMACS))
+def test_convnext_flops_match_published(arch):
+    from imagent_tpu.utils.flops import convnext_forward_flops
+    got = convnext_forward_flops(arch, 224) / 2e9  # GMACs
+    assert got == pytest.approx(PUBLISHED_CONVNEXT_GMACS[arch], rel=2e-3)
+
+
+def test_forward_flops_dispatches_convnext():
+    from imagent_tpu.utils.flops import convnext_forward_flops, forward_flops
+    assert forward_flops("convnext_tiny", 224) == convnext_forward_flops(
+        "convnext_tiny", 224)
