@@ -272,3 +272,18 @@ def test_convnext_engine_smoke(tmp_path):
                  ckpt_dir=str(tmp_path / "ckpt"))
     out = run(cfg)
     assert np.isfinite(out["final_train"]["loss"])
+
+
+def test_convnext_remat_matches():
+    """--remat wraps each block in jax.checkpoint: forward values are
+    identical; only the backward schedule changes."""
+    from imagent_tpu.models.convnext import ConvNeXt
+
+    kw = dict(depths=(1, 1, 1, 1), dims=(8, 12, 16, 24), num_classes=5)
+    x = jax.random.normal(jax.random.key(2), (2, 32, 32, 3))
+    base = ConvNeXt(**kw)
+    rem = ConvNeXt(**kw, remat=True)
+    v = base.init(jax.random.key(0), x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(base.apply(v, x, train=True)),
+        np.asarray(rem.apply(v, x, train=True)), rtol=1e-6, atol=1e-6)
