@@ -53,14 +53,18 @@ STAGES = [
     ("l4.3x3g32", 7, 1024),
 ]
 GROUPS = 32
+# One source of truth for the chain lengths: the header echo in main()
+# and the timing loop must report/use the same values.
+GC_LO_DEFAULT = "4"
+GC_HI_DEFAULT = "24"
 
 
 def _timed_chain(fn, x, reps_lo=None, reps_hi=None, pairs=3):
     """Median per-iteration time via two chained-loop lengths."""
     if reps_lo is None:
-        reps_lo = int(os.environ.get("GC_LO", "4"))
+        reps_lo = int(os.environ.get("GC_LO", GC_LO_DEFAULT))
     if reps_hi is None:
-        reps_hi = int(os.environ.get("GC_HI", "24"))
+        reps_hi = int(os.environ.get("GC_HI", GC_HI_DEFAULT))
     import jax
 
     @partial(jax.jit, static_argnums=(1,))
@@ -166,10 +170,16 @@ def main() -> int:
     batch = int(os.environ.get("GC_BATCH", "64"))
     hbm = measure_hbm_gbs()
     mxu = measure_mxu_tflops()
+    only = os.environ.get("GC_STAGE")
+    # Header echoes every env knob that shapes the numbers (reps change
+    # the timing-chain lengths, GC_STAGE the coverage) so published
+    # output is self-describing.
     print(json.dumps({"hbm_copy_gbs": round(hbm, 1),
                       "mxu_matmul_tflops": round(mxu, 1),
-                      "batch": batch}))
-    only = os.environ.get("GC_STAGE")
+                      "batch": batch,
+                      "reps_lo": int(os.environ.get("GC_LO", GC_LO_DEFAULT)),
+                      "reps_hi": int(os.environ.get("GC_HI", GC_HI_DEFAULT)),
+                      "stage_filter": only or None}))
     for name, hw, width in STAGES:
         if only and only not in name:
             continue

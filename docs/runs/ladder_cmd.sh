@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Round-5 recipe-ablation ladder (VERDICT r4 item 1): a
+# difficulty-calibrated dataset where the reference-parity recipe lands
+# mid-range and each recipe lever produces a seed-resolvable delta.
+#
+# Dataset: 128-class "huehard" generated ImageFolder
+# (imagent_tpu/data/texturegen.py::texture_hard — weak variable hue
+# dominance, per-image saturation/value nuisance, distractor hue) with
+# 25% deterministic TRAIN-ONLY label noise (val is clean). 6,400 train /
+# 1,280 val JPEGs, 96px sources, 64px crops. Chance = 0.78%.
+#
+# Usage: bash docs/runs/ladder_cmd.sh RUNG SEED
+#   RUNG: a = reference-parity (SGD + step decay + crop/flip)
+#         b = a + cosine/warmup/label-smoothing
+#         c = b + mixup/cutmix/color-jitter
+#         d = c + EMA
+# All rungs share the matched budget: 90 epochs, bs 128, lr 0.1,
+# identical data pipeline. Idempotent: --resume continues after any
+# interruption.
+#
+#   bash docs/runs/ladder_cmd.sh a 0 >> docs/runs/ladder_a0_tpu.log 2>&1
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+RUNG="$1"; SEED="$2"
+
+python - <<'EOF'
+from imagent_tpu.data.texturegen import generate_imagefolder
+generate_imagefolder(".scratch/huehard128", n_classes=128,
+                     train_per_class=50, val_per_class=10, img=96,
+                     scheme="huehard", label_noise=0.25)
+EOF
+
+EXTRA=()
+case "$RUNG" in
+  a) ;;
+  b) EXTRA+=(--schedule=cosine --warmup-epochs=5 --label-smoothing=0.1) ;;
+  c) EXTRA+=(--schedule=cosine --warmup-epochs=5 --label-smoothing=0.1
+             --mixup 0.2 --cutmix 1.0 --color-jitter 0.4 0.4 0.4) ;;
+  d) EXTRA+=(--schedule=cosine --warmup-epochs=5 --label-smoothing=0.1
+             --mixup 0.2 --cutmix 1.0 --color-jitter 0.4 0.4 0.4
+             --ema-decay 0.99) ;;
+  *) echo "unknown rung: $RUNG" >&2; exit 2 ;;
+esac
+
+exec python -m imagent_tpu \
+  --backend=tpu --dataset=imagefolder \
+  --data-root=.scratch/huehard128 \
+  --arch=resnet18 --image-size=64 --num-classes=128 \
+  --batch-size=128 --epochs=90 --lr=0.1 --seed="$SEED" \
+  --augment --input-bf16 --workers=1 \
+  --ckpt-dir="checkpoints/ladder_${RUNG}${SEED}" \
+  --log-dir="runs/ladder_${RUNG}${SEED}" \
+  --save-model --resume "${EXTRA[@]}"

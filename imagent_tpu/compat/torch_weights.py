@@ -140,6 +140,64 @@ def vit_from_torch(state_dict: dict, num_heads: int) -> dict:
     return params
 
 
+def vit_to_torch(params: dict) -> dict:
+    """The inverse of ``vit_from_torch``: our params tree → a
+    torchvision-named ViT ``state_dict`` (numpy values). The per-head
+    query/key/value DenseGeneral kernels [D, H, hd] re-fuse into
+    torchvision's ``in_proj_weight`` [3D, D] (the QKV re-split inverse),
+    and the [H, hd, D] out projection flattens back to [D, H*hd].
+    Round-trip is bit-exact (tests/test_torch_compat.py). Completes the
+    train-here/serve-in-torch story for the third family alongside
+    ``resnet_to_torch``/``convnext_to_torch``."""
+    d = np.asarray(params["class_token"]).shape[-1]
+    sd: dict = {
+        "conv_proj.weight": _conv_inv(params["conv_proj"]["kernel"]),
+        "conv_proj.bias": np.asarray(params["conv_proj"]["bias"]),
+        "class_token": np.asarray(params["class_token"]).reshape(1, 1, d),
+        "encoder.pos_embedding": np.asarray(
+            params["pos_embedding"]).reshape(1, -1, d),
+        "encoder.ln.weight": np.asarray(params["ln"]["scale"]),
+        "encoder.ln.bias": np.asarray(params["ln"]["bias"]),
+        "heads.head.weight": _linear_inv(params["head"]["kernel"]),
+        "heads.head.bias": np.asarray(params["head"]["bias"]),
+    }
+
+    def qkv_inv(p: dict) -> tuple[np.ndarray, np.ndarray]:
+        # kernel [D_in, H, hd] -> [D_out, D_in] (inverse of `qkv` in
+        # vit_from_torch); bias [H, hd] -> [D_out]
+        k = np.asarray(p["kernel"])
+        d_in = k.shape[0]
+        return (_linear_inv(k.reshape(d_in, -1)),
+                np.asarray(p["bias"]).reshape(-1))
+
+    i = 0
+    while f"encoder_layer_{i}" in params:
+        src = params[f"encoder_layer_{i}"]
+        dst = f"encoder.layers.encoder_layer_{i}"
+        qw, qb = qkv_inv(src["self_attention"]["query"])
+        kw, kb = qkv_inv(src["self_attention"]["key"])
+        vw, vb = qkv_inv(src["self_attention"]["value"])
+        sd[f"{dst}.self_attention.in_proj_weight"] = np.concatenate(
+            [qw, kw, vw], axis=0)
+        sd[f"{dst}.self_attention.in_proj_bias"] = np.concatenate(
+            [qb, kb, vb], axis=0)
+        out_k = np.asarray(src["self_attention"]["out"]["kernel"])
+        sd[f"{dst}.self_attention.out_proj.weight"] = _linear_inv(
+            out_k.reshape(-1, out_k.shape[-1]))
+        sd[f"{dst}.self_attention.out_proj.bias"] = np.asarray(
+            src["self_attention"]["out"]["bias"])
+        sd[f"{dst}.ln_1.weight"] = np.asarray(src["ln_1"]["scale"])
+        sd[f"{dst}.ln_1.bias"] = np.asarray(src["ln_1"]["bias"])
+        sd[f"{dst}.ln_2.weight"] = np.asarray(src["ln_2"]["scale"])
+        sd[f"{dst}.ln_2.bias"] = np.asarray(src["ln_2"]["bias"])
+        sd[f"{dst}.mlp.0.weight"] = _linear_inv(src["mlp_0"]["kernel"])
+        sd[f"{dst}.mlp.0.bias"] = np.asarray(src["mlp_0"]["bias"])
+        sd[f"{dst}.mlp.3.weight"] = _linear_inv(src["mlp_1"]["kernel"])
+        sd[f"{dst}.mlp.3.bias"] = np.asarray(src["mlp_1"]["bias"])
+        i += 1
+    return sd
+
+
 def _conv_inv(k) -> np.ndarray:
     return np.transpose(np.asarray(k), (3, 2, 0, 1))  # HWIO -> OIHW
 

@@ -100,31 +100,106 @@ def texture_pair(cls: int, idx: int, n_classes: int, img: int,
     return (out.clip(0, 1) * 255).astype(np.uint8)
 
 
+def texture_hard(cls: int, idx: int, n_classes: int, img: int,
+                 hue_jitter: float = 0.012) -> np.ndarray:
+    """Difficulty-calibrated variant of :func:`texture_pair` (VERDICT r4
+    item 1: a dataset where the reference-parity recipe lands mid-range
+    and recipe levers resolve). Same crop/flip-invariant class feature —
+    ordered (dominant, secondary) hue-bucket pair — but with three
+    difficulty levers layered on:
+
+    * **Weak, variable dominance**: the dominant fraction is drawn
+      per-image from U[0.56, 0.78] instead of fixed 0.70, so the margin
+      between dominant and secondary varies image to image (confusable
+      with the reversed-pair class at the low end).
+    * **Photometric nuisance**: per-image, per-hue saturation
+      U[0.45, 1.0] and value U[0.45, 0.95] — the raw RGB of a hue family
+      varies ~2x between images, so channel statistics alone do not
+      separate classes; the model must identify hue proper.
+    * **A distractor hue**: a third, non-class hue bucket occupies a
+      random 2-10% of pixels (always below the secondary's share so the
+      ordered pair stays well-defined), forcing the classifier to rank
+      the top-2 hues rather than detect "which hues are present".
+
+    Train-set label noise (the fourth lever) is applied at generation
+    time by :func:`generate_imagefolder` (``label_noise``), not here.
+    """
+    rng = np.random.default_rng(cls * 100_003 + idx)
+    n_hues, pairs = _hue_pairs(n_classes)
+    h1, h2 = pairs[cls]
+
+    def hue_rgb(h: int) -> np.ndarray:
+        return np.asarray(colorsys.hsv_to_rgb(
+            (h / n_hues + rng.uniform(-hue_jitter, hue_jitter)) % 1.0,
+            rng.uniform(0.45, 1.0), rng.uniform(0.45, 0.95)), np.float32)
+
+    c_dom, c_sec = hue_rgb(h1), hue_rgb(h2)
+    if n_hues >= 3:
+        h3 = int(rng.integers(0, n_hues - 2))
+        for taken in sorted((h1, h2)):
+            if h3 >= taken:
+                h3 += 1
+        c_dis = hue_rgb(h3)
+    else:  # 2-bucket (n_classes <= 2) smoke datasets: no third hue exists
+        c_dis = c_sec
+    d = rng.uniform(0.56, 0.78)
+    # Distractor share: capped so secondary (1-d-t) stays >= t + 0.04 —
+    # the ordered pair (dominant, secondary) remains unambiguous.
+    t_hi = min(0.10, (1.0 - d) / 2.0 - 0.02)
+    t = rng.uniform(0.02, t_hi) if n_hues >= 3 else 0.0
+    coarse = rng.normal(size=((img + 2) // 3, (img + 2) // 3))
+    noise = np.kron(coarse, np.ones((3, 3)))[:img, :img]
+    q_dom, q_dis = np.quantile(noise, [d, 1.0 - t])
+    base = np.where((noise < q_dom)[:, :, None], c_dom[None, None, :],
+                    np.where((noise >= q_dis)[:, :, None],
+                             c_dis[None, None, :], c_sec[None, None, :]))
+    yy, xx = np.mgrid[0:img, 0:img].astype(np.float32)
+    phase = rng.uniform(0, 2 * np.pi)
+    wavelength = rng.uniform(10, 18) * img / 64.0
+    theta = rng.uniform(0, np.pi)
+    wave = np.sin(2 * np.pi * (xx * np.cos(theta) + yy * np.sin(theta))
+                  / wavelength + phase)
+    lum = 0.75 + 0.25 * wave
+    out = base * lum[:, :, None] + rng.normal(0, 0.02, base.shape)
+    return (out.clip(0, 1) * 255).astype(np.uint8)
+
+
 def generate_imagefolder(root: str, n_classes: int = 8,
                          train_per_class: int = 40, val_per_class: int = 8,
                          img: int = 64, quality: int = 90,
                          hue_jitter: float | None = None,
-                         scheme: str = "hue") -> str:
+                         scheme: str = "hue",
+                         label_noise: float = 0.0) -> str:
     """Write the dataset under ``root`` (idempotent: a manifest records
     the parameters; matching manifest ⇒ reuse, mismatch ⇒ regenerate).
     ``scheme``: "hue" (single-hue classes, up to ~64 before the JPEG
-    chroma floor) or "huepair" (:func:`texture_pair`, ImageNet-shaped
-    class counts). ``hue_jitter`` defaults PER SCHEME: 0.03 for "hue"
-    (vs 1/n_classes bucket spacing) but 0.004 for "huepair", whose 23
-    hue buckets sit only 1/23 ≈ 0.0435 apart — a 0.03 jitter there
-    would overlap adjacent buckets and turn the class feature into
-    label noise."""
+    chroma floor), "huepair" (:func:`texture_pair`, ImageNet-shaped
+    class counts), or "huehard" (:func:`texture_hard`, the
+    difficulty-calibrated ladder dataset). ``hue_jitter`` defaults PER
+    SCHEME: 0.03 for "hue" (vs 1/n_classes bucket spacing) but 0.004
+    for "huepair", whose 23 hue buckets sit only 1/23 ≈ 0.0435 apart —
+    a 0.03 jitter there would overlap adjacent buckets and turn the
+    class feature into label noise — and 0.012 for "huehard".
+    ``label_noise``: fraction of TRAIN images whose content is drawn
+    from a uniformly random *other* class while staying filed under
+    the labelled class dir (deterministic per (class, index); val is
+    always clean, so the val ceiling stays high and recipe-lever
+    deltas remain resolvable at the top of the range)."""
     from PIL import Image
 
-    gen = {"hue": texture, "huepair": texture_pair}[scheme]
+    gen = {"hue": texture, "huepair": texture_pair,
+           "huehard": texture_hard}[scheme]
     if hue_jitter is None:
-        hue_jitter = 0.03 if scheme == "hue" else 0.004
+        hue_jitter = {"hue": 0.03, "huepair": 0.004,
+                      "huehard": 0.012}[scheme]
     manifest = dict(n_classes=n_classes, train_per_class=train_per_class,
                     val_per_class=val_per_class, img=img, quality=quality,
                     hue_jitter=hue_jitter, version=1)
     if scheme != "hue":
         manifest["scheme"] = scheme  # absent for "hue": round-2/3
         # manifests stay valid, existing datasets aren't regenerated
+    if label_noise:
+        manifest["label_noise"] = label_noise
     mpath = os.path.join(root, "manifest.json")
     if os.path.exists(mpath):
         try:
@@ -145,8 +220,19 @@ def generate_imagefolder(root: str, n_classes: int = 8,
             d = os.path.join(root, split, f"class_{cls}")
             os.makedirs(d, exist_ok=True)
             for i in range(per_class):
+                content_cls = cls
+                if label_noise and split == "train":
+                    # Deterministic train-only label noise: content from
+                    # a uniformly random OTHER class, filed under `cls`.
+                    nrng = np.random.default_rng(
+                        (cls * 100_003 + i) ^ 0x5EED_CAFE)
+                    if nrng.uniform() < label_noise:
+                        content_cls = int(nrng.integers(0, n_classes - 1))
+                        if content_cls >= cls:
+                            content_cls += 1
                 Image.fromarray(
-                    gen(cls, base + i, n_classes, img, hue_jitter)).save(
+                    gen(content_cls, base + i, n_classes, img,
+                        hue_jitter)).save(
                         os.path.join(d, f"{i:05d}.jpg"), quality=quality)
     with open(mpath, "w") as f:
         json.dump(manifest, f)

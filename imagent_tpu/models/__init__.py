@@ -24,8 +24,12 @@ def available_models() -> list[str]:
         names += sorted(vit.VIT_REGISTRY)
     except ImportError:  # pragma: no cover
         pass
-    from imagent_tpu.models.convnext import CONVNEXT_REGISTRY
-    return names + sorted(CONVNEXT_REGISTRY)
+    try:  # same lazy-registration contract as ViT
+        from imagent_tpu.models.convnext import CONVNEXT_REGISTRY
+        names += sorted(CONVNEXT_REGISTRY)
+    except ImportError:  # pragma: no cover
+        pass
+    return names
 
 
 def create_model(arch: str, num_classes: int = 1000, bf16: bool = False,

@@ -21,8 +21,8 @@ dimension and the two MLP projections are plain ``nn.Dense`` on the
 last axis, so no transposes exist anywhere in the program. The
 depthwise 7x7 lowers via ``feature_group_count=C`` (cg=1: pure
 HBM-streaming by the grouped-conv roofline in docs/ROOFLINE.md — its
-49 taps/channel give it ~12x the arithmetic intensity of a 3x3
-depthwise, which is why the geometry works on TPUs at all). GELU uses
+49 taps/channel give it ~5.4x the arithmetic intensity of a 3x3
+depthwise (49/9), which is why the geometry works on TPUs at all). GELU uses
 ``approximate=False`` for torch-exact numerics. No BatchNorm means no
 ``batch_stats`` collection: the train/eval steps already handle
 stat-less models via the ViT path, and there is nothing for EMA's

@@ -161,6 +161,40 @@ def test_vit_logits_match_torch():
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+def test_vit_to_torch_roundtrip():
+    """Export inverts import bit-exactly — the QKV per-head kernels
+    re-fuse into in_proj_weight in torchvision's [q; k; v] row order —
+    and the exported dict loads into a FRESH torch ViT reproducing the
+    Flax logits (train-here/serve-in-torch for the third family)."""
+    from imagent_tpu.compat import vit_to_torch
+
+    torch.manual_seed(7)
+    tm = TorchViT().eval()
+    with torch.no_grad():
+        tm.class_token.normal_(std=0.02)
+    sd0 = {k: v.numpy() for k, v in tm.state_dict().items()}
+
+    params = vit_from_torch(sd0, num_heads=4)
+    sd1 = vit_to_torch(params)
+    assert set(sd1) == set(sd0)
+    for k, v in sd0.items():
+        np.testing.assert_array_equal(sd1[k], v, err_msg=k)
+
+    tm2 = TorchViT().eval()
+    tm2.load_state_dict({k: torch.from_numpy(np.asarray(v).copy())
+                         for k, v in sd1.items()})
+    fm = VisionTransformer(patch_size=8, hidden_dim=64, num_layers=2,
+                           num_heads=4, mlp_dim=128, num_classes=10)
+    x = np.random.default_rng(11).normal(
+        size=(4, 3, 32, 32)).astype(np.float32)
+    with torch.no_grad():
+        want = tm2(torch.from_numpy(x)).numpy()
+    got = np.asarray(fm.apply(
+        {"params": params, "batch_stats": {}},
+        np.transpose(x, (0, 2, 3, 1)), train=False))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
 def test_engine_init_from_torch(tmp_path):
     """--init-from-torch end-to-end: the reference's DDP-prefixed .pt
     loads into a training run; wrong arch fails loudly."""
