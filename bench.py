@@ -22,13 +22,29 @@ is skipped — the primary line must survive it).
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 BASELINE_IMG_S_PER_CHIP = 152.8  # reference img/s/GPU (BASELINE.md)
 NORTH_STAR_IMG_S_PER_CHIP = 1200.0  # BASELINE.json resnet50@224 target
+
+
+def chip_calibration() -> dict:
+    """Per-run chip-state snapshot (VERDICT r4 item 2): the roofline
+    copy-bandwidth and matmul microbenches ride alongside every BENCH
+    record, so a cross-session drift in a bandwidth-sensitive config
+    (r18@448) can be attributed to chip/tunnel state vs the estimator —
+    compare the drift against these two numbers' drift. Measured on
+    this chip: ~644 GB/s copy, ~196 TFLOP/s matmul (docs/ROOFLINE.md)."""
+    from benchmarks.roofline import measure_hbm_gbs, measure_mxu_tflops
+
+    return {"hbm_copy_gbs": round(measure_hbm_gbs(), 1),
+            "mxu_matmul_tflops": round(measure_mxu_tflops(), 1)}
 
 
 def measure(arch: str, size: int, per_chip_batch: int,
@@ -141,9 +157,15 @@ def main() -> int:
     primary = measure("resnet18", 448, 128)
     primary["vs_baseline"] = round(
         primary["value"] / BASELINE_IMG_S_PER_CHIP, 3)
+    try:
+        primary["chip_calibration"] = chip_calibration()
+    except Exception as e:  # noqa: BLE001 — never take down the record
+        primary["chip_calibration_error"] = f"{type(e).__name__}: {e}"[:200]
 
     # A failing secondary config must not take down the whole round's
-    # record (nor its sibling): the primary line prints regardless.
+    # record (nor its siblings): the primary line prints regardless.
+    # The full README family table rides here (VERDICT r4 item 4) so
+    # every published number is driver-measured.
     def north_star():
         m = measure("resnet50", 224, 256)
         m["vs_baseline"] = round(m["value"] / NORTH_STAR_IMG_S_PER_CHIP, 3)
@@ -151,7 +173,11 @@ def main() -> int:
 
     primary["extra"] = []
     for fn in (north_star,
-               lambda: measure("vit_b16", 224, 256, optimizer="adamw")):
+               lambda: measure("vit_b16", 224, 256, optimizer="adamw"),
+               lambda: measure("wide_resnet50_2", 224, 256),
+               lambda: measure("resnext50_32x4d", 224, 256),
+               lambda: measure("convnext_tiny", 224, 256,
+                               optimizer="adamw")):
         try:
             primary["extra"].append(fn())
         except Exception as e:  # noqa: BLE001

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Round-5 448px end-to-end capture (VERDICT r4 item 2 of "What's
+# missing"): the reference's run of record trains at 448px through real
+# JPEG decode and its epoch walltime is its own quantity
+# (/root/reference/imagent_sgd.out:278, ~524 s/epoch on 16 V100s).
+# This capture ties decode -> prefetch -> H2D -> 448px jitted step
+# together ON HARDWARE at that geometry for a few epochs, through the
+# real CLI: 16-class generated JPEG ImageFolder with 512px sources
+# (RandomResizedCrop to 448), native C++ decode, bf16 H2D, per-step
+# data_time in the log. After the training epochs it runs
+# benchmarks/e2e_epoch.py at the same geometry for the per-stage rate
+# instrument (decode img/s/core, H2D MB/s, compute img/s/chip, which
+# stage binds).
+#
+#   bash docs/runs/e2e448_cmd.sh >> docs/runs/e2e448_tpu.log 2>&1
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python - <<'EOF'
+from imagent_tpu.data.texturegen import generate_imagefolder
+generate_imagefolder(".scratch/e2e448", n_classes=16,
+                     train_per_class=250, val_per_class=25, img=512,
+                     scheme="hue")
+EOF
+
+python -m imagent_tpu \
+  --backend=tpu --dataset=imagefolder \
+  --data-root=.scratch/e2e448 \
+  --arch=resnet18 --image-size=448 --num-classes=16 \
+  --batch-size=128 --epochs=4 --lr=0.1 \
+  --augment --input-bf16 --workers=1 \
+  --ckpt-dir=checkpoints/e2e448 \
+  --log-dir=runs/e2e448 \
+  --save-model --resume
+
+echo "=== per-stage instrument (benchmarks/e2e_epoch.py, same geometry) ==="
+python benchmarks/e2e_epoch.py --image-size 448 --batch-size 128

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Sequentially run the remaining recipe-ablation ladder cells (rung A
+# seed 0 was the calibration probe). One chip, so one run at a time;
+# each cell is idempotent/resumable via ladder_cmd.sh.
+set -uo pipefail
+cd "$(dirname "$0")/../.."
+for cell in "b 0" "c 0" "d 0" "a 1" "b 1" "c 1" "d 1"; do
+  set -- $cell
+  echo "=== ladder rung $1 seed $2 start $(date -u +%H:%M:%S) ==="
+  bash docs/runs/ladder_cmd.sh "$1" "$2" \
+    >> "docs/runs/ladder_$1$2_tpu.log" 2>&1 \
+    || echo "=== ladder rung $1 seed $2 FAILED ==="
+done
+echo "=== ladder queue done $(date -u +%H:%M:%S) ==="

@@ -1,9 +1,11 @@
-"""Shared harness for the two-OS-process workers (mp_worker.py,
-mp_worker_tp.py): free-port rendezvous, env scrub, paired spawn with
-collect/kill, and METRICS-line parsing. Used by both
-tests/test_multiprocess.py and the driver's dryrun phase
-(__graft_entry__._dryrun_cross_process_model_axis) so the spawn
-contract can't drift between them."""
+"""Shared harness for the N-OS-process workers (mp_worker.py,
+mp_worker_tp.py at 2 ranks; mp_worker_fsdp.py, mp_worker_pp.py at 4):
+free-port rendezvous, env scrub, group spawn with collect/kill, and
+METRICS-line parsing. Worker argv contract: ``worker.py <rank> <port>
+<world>`` (the two-rank round-3/4 workers ignore the trailing world
+argument). Used by both tests/test_multiprocess.py and the driver's
+cross-process dryrun phases (__graft_entry__._cross_process_phase) so
+the spawn contract can't drift between them."""
 
 from __future__ import annotations
 
@@ -34,16 +36,18 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def launch_pair(worker: str, timeout: float = 300) -> list[str]:
-    """Run ranks 0 and 1 of ``worker`` (a path under tests/) against a
-    fresh rendezvous port; return both outputs. Raises AssertionError
-    with the combined output if either rank fails."""
+def launch_group(worker: str, n_procs: int, timeout: float = 300,
+                 ) -> list[str]:
+    """Run ranks 0..n_procs-1 of ``worker`` (a path under tests/)
+    against a fresh rendezvous port; return all outputs. Raises
+    AssertionError with the combined output if any rank fails."""
     port = free_port()
     procs = [subprocess.Popen(
-        [sys.executable, os.path.join(_DIR, worker), str(rank), str(port)],
+        [sys.executable, os.path.join(_DIR, worker), str(rank), str(port),
+         str(n_procs)],
         cwd=_REPO, env=clean_env(),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for rank in (0, 1)]
+        for rank in range(n_procs)]
     try:
         outs = [p.communicate(timeout=timeout)[0] for p in procs]
     finally:
@@ -53,6 +57,12 @@ def launch_pair(worker: str, timeout: float = 300) -> list[str]:
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"{worker} rank failed:\n{out}"
     return outs
+
+
+def launch_pair(worker: str, timeout: float = 300) -> list[str]:
+    """Two-rank wrapper over :func:`launch_group` (the round-3/4
+    workers ignore the trailing world-size argv)."""
+    return launch_group(worker, 2, timeout)
 
 
 def parse_metrics(out: str) -> np.ndarray:

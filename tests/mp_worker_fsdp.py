@@ -1,0 +1,88 @@
+"""Worker for the cross-process FSDP test (test_multiprocess.py).
+
+The round-4 coverage crossed DP gradient psums and TP activation psums
+over an OS-process boundary; this worker crosses the THIRD collective
+family: FSDP's parameter all-gathers and gradient reduce-scatters
+(inserted by the XLA SPMD partitioner, parallel/fsdp.py). Four
+processes x 1 fake device form a 4-device ``data`` mesh; every
+parameter is sharded over that axis, so each layer's all-gather and
+each gradient's reduce-scatter crosses process boundaries — the
+FSDP-over-DCN case that breaks first on real pods. The reference
+cannot express this (flat DDP NCCL world, ``imagenet.py:270-273``).
+
+Each process contributes its 2 rows of the global 8-row batch; the
+parent asserts all ranks agree and match a single-process FSDP run on
+the concatenated batch.
+
+Usage: python mp_worker_fsdp.py <rank> <port> <world>
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    world = int(sys.argv[3])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1")
+    os.environ.update({
+        "SLURM_JOB_NUM_NODES": str(world),
+        "SLURM_NODEID": str(rank),
+        "SLURM_LOCALID": "0",
+        "SLURM_PROCID": str(rank),
+        "SLURM_NTASKS": str(world),
+        "SLURM_JOB_NODELIST": "127.0.0.1",
+    })
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from imagent_tpu import cluster
+    from imagent_tpu.models.vit import VisionTransformer
+    from imagent_tpu.parallel.fsdp import fsdp_state_specs
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step_auto,
+        place_state, shard_batch,
+    )
+
+    senv = cluster.initialize("cpu", port=port)
+    assert senv is not None and senv.world_size == world
+    print(cluster.rank_banner(senv), flush=True)
+
+    mesh = cluster.make_mesh()
+    assert mesh.devices.size == world  # 1 fake device per process
+    procs_on_data = {d.process_index for d in mesh.devices.ravel()}
+    assert len(procs_on_data) == world, "data axis must span all processes"
+
+    model = VisionTransformer(patch_size=8, hidden_dim=32, num_layers=2,
+                              num_heads=4, mlp_dim=64, num_classes=4)
+    opt = make_optimizer(name="adamw")
+    host = jax.device_get(
+        create_train_state(model, jax.random.key(0), 32, opt))
+    specs = fsdp_state_specs(host, world)
+    state = place_state(host, mesh, specs)
+    step = make_train_step_auto(model, opt, mesh, specs)
+
+    # Global batch 8; this process contributes rows [rank*2, rank*2+2).
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(8,)).astype(np.int32)
+    lo = rank * (8 // world)
+    gi, gl = shard_batch(mesh, images[lo:lo + 8 // world],
+                         labels[lo:lo + 8 // world])
+    assert gi.shape == (8, 32, 32, 3)  # global shape spans all procs
+
+    _, metrics = step(state, gi, gl, np.float32(0.01))
+    m = np.asarray(metrics)
+    print("METRICS", " ".join(f"{x:.6f}" for x in m), flush=True)
+
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+"""Worker for the cross-process PIPELINE test (test_multiprocess.py).
+
+Crosses the FOURTH collective family over an OS-process boundary:
+GPipe's ``ppermute`` stage-to-stage activation transfers
+(parallel/pipeline.py). Four processes x 1 fake device form a
+(data=1, pipe=4) mesh — each encoder layer of a 4-layer ViT lives in a
+DIFFERENT process, so every microbatch hop (forward) and its reverse
+(backward) crosses a process boundary, the multi-host pipeline case on
+real pods. The reference cannot express pipelining at all.
+
+The batch is replicated over the pipe axis (data=1), so every process
+feeds the identical full global batch — same contract as the TP worker
+(each process's addressable shard is the whole array). The parent
+asserts all ranks agree and match a single-process run of the same
+pipelined program.
+
+Usage: python mp_worker_pp.py <rank> <port> <world>
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    world = int(sys.argv[3])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1")
+    os.environ.update({
+        "SLURM_JOB_NUM_NODES": str(world),
+        "SLURM_NODEID": str(rank),
+        "SLURM_LOCALID": "0",
+        "SLURM_PROCID": str(rank),
+        "SLURM_NTASKS": str(world),
+        "SLURM_JOB_NODELIST": "127.0.0.1",
+    })
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from imagent_tpu import cluster
+    from imagent_tpu.models.vit import VisionTransformer
+    from imagent_tpu.parallel.pipeline import vit_pp_param_specs
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step, place_state,
+        shard_batch, state_partition_specs,
+    )
+
+    senv = cluster.initialize("cpu", port=port)
+    assert senv is not None and senv.world_size == world
+    print(cluster.rank_banner(senv), flush=True)
+
+    mesh = cluster.make_mesh(pipeline_parallel=world)
+    assert mesh.shape[cluster.PIPE_AXIS] == world
+    pipe_procs = {d.process_index for d in mesh.devices.ravel()}
+    assert len(pipe_procs) == world, "pipe axis must span all processes"
+
+    vit_kw = dict(patch_size=8, hidden_dim=32, num_layers=world,
+                  num_heads=4, mlp_dim=64, num_classes=4)
+    model = VisionTransformer(**vit_kw, pipe_axis=cluster.PIPE_AXIS,
+                              microbatches=2)
+    init_model = VisionTransformer(**vit_kw, stacked=True)
+    opt = make_optimizer()
+    state = create_train_state(init_model, jax.random.key(0), 32, opt)
+    specs = state_partition_specs(state, vit_pp_param_specs(state.params))
+    state = place_state(state, mesh, specs)
+    step = make_train_step(model, opt, mesh, state_specs=specs,
+                           pipe_axis=cluster.PIPE_AXIS)
+
+    # data=1: the batch is replicated over pipe — every process feeds
+    # the identical full global batch.
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(8,)).astype(np.int32)
+    gi, gl = shard_batch(mesh, images, labels)
+    assert gi.shape == (8, 32, 32, 3)
+
+    _, metrics = step(state, gi, gl, np.float32(0.05))
+    m = np.asarray(metrics)
+    print("METRICS", " ".join(f"{x:.6f}" for x in m), flush=True)
+
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

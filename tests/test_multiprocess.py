@@ -9,7 +9,7 @@ suite fakes multi-device inside one process)."""
 
 import numpy as np
 
-from mp_launch import launch_pair, parse_metrics
+from mp_launch import launch_group, launch_pair, parse_metrics
 
 
 def test_two_process_train_step_matches_single():
@@ -42,6 +42,91 @@ def test_two_process_train_step_matches_single():
     state = replicate_state(
         create_train_state(model, jax.random.key(0), 32, opt), mesh)
     step = make_train_step(model, opt, mesh)
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(8,)).astype(np.int32)
+    gi, gl = shard_batch(mesh, images, labels)
+    _, want = step(state, gi, gl, np.float32(0.05))
+    np.testing.assert_allclose(metrics[0], np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_four_process_fsdp_matches_single():
+    """FSDP's collective family (parameter all-gather + gradient
+    reduce-scatter, inserted by the XLA SPMD partitioner) crossing real
+    OS-process boundaries — 4 processes x 1 device form the ``data``
+    axis, so every layer's all-gather spans processes (VERDICT r4
+    item 3: the FSDP-over-DCN case). All ranks agree and match a
+    single-process FSDP run on the concatenated batch."""
+    outs = launch_group("mp_worker_fsdp.py", 4)
+    metrics = [parse_metrics(out) for out in outs]
+    for m in metrics[1:]:
+        np.testing.assert_allclose(metrics[0], m, rtol=1e-6)
+    assert metrics[0][3] == 8.0  # count spans all four processes
+
+    import jax
+
+    from imagent_tpu.cluster import make_mesh
+    from imagent_tpu.models.vit import VisionTransformer
+    from imagent_tpu.parallel.fsdp import fsdp_state_specs
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step_auto,
+        place_state, shard_batch,
+    )
+
+    mesh = make_mesh(devices=jax.devices()[:4])
+    model = VisionTransformer(patch_size=8, hidden_dim=32, num_layers=2,
+                              num_heads=4, mlp_dim=64, num_classes=4)
+    opt = make_optimizer(name="adamw")
+    host = jax.device_get(
+        create_train_state(model, jax.random.key(0), 32, opt))
+    specs = fsdp_state_specs(host, 4)
+    state = place_state(host, mesh, specs)
+    step = make_train_step_auto(model, opt, mesh, specs)
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(8,)).astype(np.int32)
+    gi, gl = shard_batch(mesh, images, labels)
+    _, want = step(state, gi, gl, np.float32(0.01))
+    np.testing.assert_allclose(metrics[0], np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_four_process_pipeline_matches_single():
+    """GPipe's ``ppermute`` stage hops crossing real OS-process
+    boundaries — 4 processes x 1 device form the ``pipe`` axis, one
+    encoder layer per process, so every microbatch activation transfer
+    (and its backward reverse) crosses a boundary (VERDICT r4 item 3).
+    All ranks agree and match the single-process pipelined program."""
+    outs = launch_group("mp_worker_pp.py", 4)
+    metrics = [parse_metrics(out) for out in outs]
+    for m in metrics[1:]:
+        np.testing.assert_allclose(metrics[0], m, rtol=1e-6)
+    assert metrics[0][3] == 8.0
+
+    import jax
+
+    from imagent_tpu import cluster
+    from imagent_tpu.models.vit import VisionTransformer
+    from imagent_tpu.parallel.pipeline import vit_pp_param_specs
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step, place_state,
+        shard_batch, state_partition_specs,
+    )
+
+    mesh = cluster.make_mesh(pipeline_parallel=4,
+                             devices=jax.devices()[:4])
+    vit_kw = dict(patch_size=8, hidden_dim=32, num_layers=4,
+                  num_heads=4, mlp_dim=64, num_classes=4)
+    model = VisionTransformer(**vit_kw, pipe_axis=cluster.PIPE_AXIS,
+                              microbatches=2)
+    init_model = VisionTransformer(**vit_kw, stacked=True)
+    opt = make_optimizer()
+    state = create_train_state(init_model, jax.random.key(0), 32, opt)
+    specs = state_partition_specs(state, vit_pp_param_specs(state.params))
+    state = place_state(state, mesh, specs)
+    step = make_train_step(model, opt, mesh, state_specs=specs,
+                           pipe_axis=cluster.PIPE_AXIS)
     rng = np.random.default_rng(0)
     images = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
     labels = rng.integers(0, 4, size=(8,)).astype(np.int32)
