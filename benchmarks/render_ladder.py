@@ -1,0 +1,106 @@
+"""Render the round-5 recipe-ablation ladder comparison to one PNG.
+
+One panel, one axis: clean-val Top-1 vs epoch for the four recipe
+rungs (A reference-parity, B +cosine/warmup/smoothing, C +mixup/
+cutmix/jitter, D +EMA), two seeds each, read straight from the TB
+events the torch-free writer emitted during the hardware runs.
+
+Dataviz method: change-over-time comparison -> line chart; color
+follows the ENTITY (rung) in the validated reference categorical
+order — slots 1-4 (blue #2a78d6, orange #eb6834, aqua #1baf7a,
+yellow #eda100), a prefix of the palette whose adjacent-pair CVD
+separation is validated in the dataviz reference instance (worst
+adjacent dE 9.1, light mode) — seeds share their rung's hue and are
+distinguished by line style (solid seed 0 / dashed seed 1: secondary
+encoding, not a fifth hue). 2px lines, recessive grid, legend +
+selective direct end labels, text in ink tokens, light surface,
+single y axis.
+
+    python benchmarks/render_ladder.py --log-root runs \
+        --out docs/runs/ladder_curves.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from benchmarks.render_curves import GRID, INK, INK_2, SURFACE, \
+    read_scalar  # noqa: E402
+
+RUNGS = [
+    ("a", "A reference-parity", "#2a78d6"),
+    ("b", "B +cosine/warmup/smooth", "#eb6834"),
+    ("c", "C +mixup/cutmix/jitter", "#1baf7a"),
+    ("d", "D +EMA", "#eda100"),
+]
+
+
+def render(log_root: str, out: str) -> str:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(9, 5.5), dpi=150, facecolor=SURFACE)
+    ax.set_facecolor(SURFACE)
+    end_labels = []  # (y_end, text) — de-collided below
+    for rung, label, color in RUNGS:
+        for seed, style in ((0, "-"), (1, "--")):
+            d = os.path.join(log_root, f"ladder_{rung}{seed}", "Top1_test")
+            if not os.path.isdir(d):  # cell not run (or not yet)
+                continue
+            pts = read_scalar(os.path.join(log_root, f"ladder_{rung}{seed}"),
+                              "Top1_test", "Top1")
+            if not pts:
+                continue
+            xs, ys = zip(*pts)
+            ax.plot(xs, ys, style, color=color, linewidth=2,
+                    label=label if seed == 0 else None)
+            if seed == 0:  # selective direct end label, one per rung
+                end_labels.append(
+                    [xs[-1], ys[-1],
+                     f" {label.split()[0]} best {max(ys):.1f}"])
+    # Push overlapping end labels apart (bottom-up, min 2.8 y-units).
+    by_y = sorted(end_labels, key=lambda e: e[1])
+    for prev, cur in zip(by_y, by_y[1:]):
+        if cur[1] - prev[1] < 2.8:
+            cur[1] = prev[1] + 2.8
+    for x, y, text in end_labels:
+        ax.annotate(text, (x, y), color=INK_2, fontsize=8, va="center")
+    ax.set_xlabel("epoch", color=INK, fontsize=10)
+    ax.set_ylabel("val Top-1 (%) — clean labels", color=INK, fontsize=10)
+    ax.grid(True, color=GRID, linewidth=0.8)
+    ax.tick_params(colors=INK_2, labelsize=8)
+    for s in ax.spines.values():
+        s.set_color(GRID)
+    ax.margins(x=0.02)
+    leg = ax.legend(frameon=False, fontsize=8, labelcolor=INK_2,
+                    loc="lower right", title="solid seed 0 / dashed seed 1")
+    leg.get_title().set_color(INK_2)
+    leg.get_title().set_fontsize(8)
+    fig.suptitle("Recipe ladder on the difficulty-calibrated dataset "
+                 "(25% train label noise, val clean)",
+                 color=INK, fontsize=11)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    fig.savefig(out, facecolor=SURFACE, bbox_inches="tight")
+    plt.close(fig)
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--log-root", default="runs")
+    p.add_argument("--out", default="docs/runs/ladder_curves.png")
+    a = p.parse_args()
+    print(render(a.log_root, a.out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,4 @@
 from imagent_tpu.compat.torch_weights import (  # noqa: F401
     convnext_from_torch, convnext_to_torch, resnet_from_torch,
-    resnet_to_torch, vit_from_torch, vit_to_torch,
+    resnet_to_torch, to_torch_state_dict, vit_from_torch, vit_to_torch,
 )

@@ -198,6 +198,24 @@ def vit_to_torch(params: dict) -> dict:
     return sd
 
 
+def to_torch_state_dict(arch: str, params: dict,
+                        batch_stats: dict | None = None) -> dict:
+    """Arch-dispatched export: our trees → a torchvision-named
+    ``state_dict`` (numpy values) for any supported ``--arch``. Used by
+    the CLI ``--export-torch`` flag (engine.run) and usable directly.
+    The inverse of what ``--init-from-torch`` accepts, minus the DDP
+    ``module.`` prefix (torchvision-loadable, ``imagenet.py:392``)."""
+    if arch.startswith("vit"):
+        return vit_to_torch(params)
+    if arch.startswith("convnext"):
+        return convnext_to_torch(params)
+    from imagent_tpu.models.resnet import STAGE_SIZES
+
+    if arch not in STAGE_SIZES:
+        raise ValueError(f"no torch export for arch {arch!r}")
+    return resnet_to_torch(params, batch_stats or {}, STAGE_SIZES[arch])
+
+
 def _conv_inv(k) -> np.ndarray:
     return np.transpose(np.asarray(k), (3, 2, 0, 1))  # HWIO -> OIHW
 

@@ -56,6 +56,10 @@ class Config:
     # converted via compat/torch_weights.py. ResNet + ViT +
     # ConvNeXt archs.
     init_from_torch: str = ""
+    # Write the final params as a torchvision-named torch .pt
+    # state_dict at run end (the inverse of --init-from-torch; all
+    # three families) — train here, serve/analyze in torch.
+    export_torch: str = ""
     # RandomResizedCrop + hflip train augmentation. The reference has NONE
     # (SURVEY §0: Resize+Normalize only, hence its 63% top-1); required for
     # the north-star accuracy config (BASELINE.md).
@@ -206,6 +210,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--init-from-torch", type=str, default="",
                    help="torch .pt state_dict to convert and load "
                         "(the reference's checkpoint format)")
+    p.add_argument("--export-torch", type=str, default="",
+                   help="write the final params as a torchvision-named "
+                        "torch .pt state_dict (inverse of "
+                        "--init-from-torch)")
     p.add_argument("--augment", action="store_true", default=False,
                    help="RandomResizedCrop+hflip train augmentation "
                         "(reference parity is OFF)")

@@ -236,6 +236,52 @@ def _load_torch_weights(cfg: Config, state: TrainState) -> TrainState:
     return state.replace(params=params, batch_stats=stats)
 
 
+def _export_torch(cfg: Config, state, is_master: bool) -> None:
+    """--export-torch: write the final params (+ batch_stats) as a
+    torchvision-named torch ``state_dict`` — the inverse of
+    ``--init-from-torch`` (the reference's checkpoint format,
+    ``imagenet.py:392``, without the DDP prefix so torchvision loads it
+    directly). Under ``--ema-decay`` the EMA weights are exported —
+    the same weights every reported val metric was evaluated on
+    (``evaluate()``), so the exported model reproduces the logged
+    accuracy. Runs after training or the ``--eval-only`` pass."""
+    if not cfg.export_torch:
+        return
+    # Eval parity: export what evaluate() scores.
+    if cfg.ema_decay > 0.0 and state.ema_params is not None:
+        state = state.replace(params=state.ema_params)
+        if state.ema_batch_stats is not None:
+            state = state.replace(batch_stats=state.ema_batch_stats)
+    if jax.process_count() > 1:
+        # Sharded leaves are not fully addressable on any one host —
+        # gather them (same multihost path as the stop-flag reduce).
+        from jax.experimental import multihost_utils
+        params = multihost_utils.process_allgather(state.params)
+        stats = multihost_utils.process_allgather(state.batch_stats)
+    else:
+        params = jax.device_get(state.params)
+        stats = jax.device_get(state.batch_stats)
+    if not is_master:
+        return
+    import torch
+
+    from imagent_tpu.compat import to_torch_state_dict
+
+    sd = to_torch_state_dict(cfg.arch, params, stats)
+
+    def as_tensor(v):
+        t = np.asarray(v)
+        if t.dtype.kind in "iu":
+            t = t.copy()  # from_numpy needs an owned, writable buffer
+        else:  # bf16 params upcast losslessly; astype always copies
+            t = t.astype(np.float32)
+        return torch.from_numpy(t)
+
+    torch.save({k: as_tensor(v) for k, v in sd.items()}, cfg.export_torch)
+    print(f"exported torch state_dict ({len(sd)} tensors) to "
+          f"{cfg.export_torch}", flush=True)
+
+
 def run(cfg: Config, stop_check=None) -> dict:
     """Full training run. Returns the final summary dict.
 
@@ -595,6 +641,7 @@ def run(cfg: Config, stop_check=None) -> dict:
                   f"({val_m['n']} samples, {val_t:.1f}s)", flush=True)
         if cfg.profile and is_master:
             jax.profiler.stop_trace()
+        _export_torch(cfg, state, is_master)
         logger.close()
         return {"best_top1": val_m["top1"], "best_top5": val_m["top5"],
                 "best_epoch": start_epoch - 1,
@@ -649,6 +696,11 @@ def run(cfg: Config, stop_check=None) -> dict:
     ckpt_lib.wait_until_finished()  # land any in-flight async save
     if cfg.profile and is_master:
         jax.profiler.stop_trace()
+    if not preempted:
+        # Skip under preemption: the grace window is for the mid-epoch
+        # checkpoint, not a full-model serialize — the resumed run
+        # exports the true final state.
+        _export_torch(cfg, state, is_master)
     total_min = (time.time() - run_t0) / 60.0
     logger.final_summary(best_epoch, best_top1, best_top5, total_min)
     logger.close()
