@@ -50,7 +50,7 @@ def render(log_root: str, out: str) -> str:
     ax.set_facecolor(SURFACE)
     end_labels = []  # (y_end, text) — de-collided below
     for rung, label, color in RUNGS:
-        for seed, style in ((0, "-"), (1, "--")):
+        for seed, style in ((0, "-"), (1, "--"), (2, ":")):
             d = os.path.join(log_root, f"ladder_{rung}{seed}", "Top1_test")
             if not os.path.isdir(d):  # cell not run (or not yet)
                 continue
@@ -80,7 +80,8 @@ def render(log_root: str, out: str) -> str:
         s.set_color(GRID)
     ax.margins(x=0.02)
     leg = ax.legend(frameon=False, fontsize=8, labelcolor=INK_2,
-                    loc="lower right", title="solid seed 0 / dashed seed 1")
+                    loc="lower right",
+                    title="solid seed 0 / dashed seed 1 / dotted seed 2")
     leg.get_title().set_color(INK_2)
     leg.get_title().set_fontsize(8)
     fig.suptitle("Recipe ladder on the difficulty-calibrated dataset "
