@@ -1,0 +1,18 @@
+# Developer entry points. Everything runs hardware-free on the CPU
+# backend (8 fake devices via conftest.py).
+
+PY ?= python
+PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
+
+.PHONY: smoke test
+
+# Fast confidence tier (<5 min on CPU): the resilience unit tests, the
+# end-to-end fault-injection drills (torn checkpoint, NaN rollback,
+# watchdog, SIGTERM), and the core e2e train/resume smoke.
+smoke:
+	$(PYTEST) -m "not slow" tests/test_resilience.py \
+	    tests/test_fault_drills.py tests/test_e2e.py
+
+# The full tier-1 gate (what CI runs).
+test:
+	$(PYTEST) -m "not slow" --continue-on-collection-errors tests/

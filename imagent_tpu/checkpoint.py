@@ -22,12 +22,15 @@ from __future__ import annotations
 
 import json
 import os
+import re
+import threading
 from typing import Any
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from imagent_tpu.resilience import faultinject, integrity
 from imagent_tpu.train import TrainState
 
 BEST = "best"
@@ -50,10 +53,14 @@ _META_FIELDS = (
 )
 
 _ckptr: ocp.StandardCheckpointer | None = None
-_pending_commit: tuple[str, str, dict] | None = None
+_pending_commit: tuple[str, str, dict, int] | None = None
+_manifest_thread: threading.Thread | None = None
 
 _STAGING = ".staging"  # never restored; the in-flight write target
 _OLD = ".old"          # previous checkpoint during the commit swap
+# keep_last_k rotation: the previous live checkpoints survive as
+# name.1 (newest) .. name.K (oldest) — the "previous LAST" rungs of the
+# fallback restore chain (restore_resilient).
 
 
 def _checkpointer() -> ocp.StandardCheckpointer:
@@ -73,31 +80,131 @@ def _write_meta(ckpt_dir: str, name: str, meta: dict) -> None:
             json.dump(meta, f)
 
 
-def _commit(ckpt_dir: str, name: str, meta: dict) -> None:
+def _remove_checkpoint(ckpt_dir: str, name: str) -> None:
+    """Delete a checkpoint dir and both sidecars (meta + manifest)."""
+    import shutil
+
+    shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
+    for sidecar in (_meta_path(ckpt_dir, name),
+                    integrity.manifest_path(ckpt_dir, name)):
+        try:
+            os.remove(sidecar)
+        except OSError:
+            pass
+
+
+def _shift_checkpoint(ckpt_dir: str, src: str, dst: str) -> None:
+    """Rename a checkpoint dir + sidecars (dst is cleared first)."""
+    _remove_checkpoint(ckpt_dir, dst)
+    os.rename(os.path.join(ckpt_dir, src), os.path.join(ckpt_dir, dst))
+    for path_of in (_meta_path, integrity.manifest_path):
+        try:
+            os.rename(path_of(ckpt_dir, src), path_of(ckpt_dir, dst))
+        except OSError:
+            pass  # sidecar absent (older-version checkpoint)
+
+
+def _join_manifest() -> None:
+    """Land any in-flight background manifest hash. Must run before
+    anything renames/deletes checkpoint dirs (the hash walks them) and
+    before a restore trusts a manifest."""
+    global _manifest_thread
+    if _manifest_thread is not None:
+        _manifest_thread.join()
+        _manifest_thread = None
+
+
+def _write_manifest_bg(ckpt_dir: str, name: str) -> None:
+    """Checksum the committed tree on a helper thread: a committed
+    checkpoint is immutable, so hashing overlaps the next epoch's
+    training instead of stalling the loop for seconds-to-minutes on a
+    multi-GB tree (the whole point of the async save path). Joined at
+    the next commit/wait. Runs synchronously while a fault drill is
+    armed — the torn-checkpoint fault must tear bytes the manifest has
+    already recorded as good, deterministically."""
+    global _manifest_thread
+
+    def work():
+        try:
+            integrity.write_manifest(ckpt_dir, name)
+        except OSError as e:  # a failed manifest must not kill the run:
+            # the checkpoint itself is committed; it just restores
+            # unverified like a pre-integrity one.
+            print(f"WARNING: could not write checkpoint manifest for "
+                  f"{name}: {e}", flush=True)
+
+    if faultinject.active():
+        work()
+        return
+    _manifest_thread = threading.Thread(
+        target=work, name=f"manifest-{name}", daemon=True)
+    _manifest_thread.start()
+
+
+def _tear_file(root: str) -> None:
+    """``torn-checkpoint`` fault: truncate the largest file under the
+    just-committed checkpoint to half its size — the on-disk state a
+    kill racing the final write leaves behind."""
+    victim, vsize = None, -1
+    for dirpath, _, filenames in os.walk(root):
+        for fn in filenames:
+            full = os.path.join(dirpath, fn)
+            size = os.path.getsize(full)
+            if size > vsize:
+                victim, vsize = full, size
+    if victim is not None:
+        with open(victim, "r+b") as f:
+            f.truncate(vsize // 2)
+        print(f"FAULT torn-checkpoint: truncated {victim} "
+              f"({vsize} -> {vsize // 2} bytes)", flush=True)
+
+
+def _commit(ckpt_dir: str, name: str, meta: dict,
+            keep_last_k: int = 0) -> None:
     """Swap the finalized staging checkpoint into the live name.
 
     The live checkpoint is NEVER the write target (a process killed
     mid-async-save must not destroy the last durable state — an Orbax
     ``save(path, force=True)`` clears ``path`` long before the new data
     is complete, which is exactly the preemption-durability hole this
-    dance closes). Worst crash case here leaves ``name.old`` + staging,
-    both handled by ``restore``."""
+    dance closes). With ``keep_last_k > 0`` the displaced live
+    checkpoint is rotated to ``name.1`` (older ones shifting to
+    ``name.2``..``name.K``) instead of deleted — the fallback rungs
+    ``restore_resilient`` walks when the live copy fails integrity
+    verification. Worst crash case leaves staging plus ``name.old`` /
+    ``name.1``, all handled by ``restore``. After the swap, a checksum
+    manifest of the committed tree is written (``resilience/
+    integrity.py``) so restore can verify the bytes it is about to
+    trust."""
     import shutil
 
     if jax.process_index() == 0:
+        _join_manifest()  # the hash walks dirs the renames below touch
         staging = os.path.join(ckpt_dir, name + _STAGING)
         live = os.path.join(ckpt_dir, name)
         old = os.path.join(ckpt_dir, name + _OLD)
         if os.path.isdir(live):
-            # Clear .old only when a live checkpoint is about to replace
-            # it — if live is absent (recovering from a prior mid-commit
-            # crash), .old IS the only durable state and must survive
-            # until the new live lands.
-            shutil.rmtree(old, ignore_errors=True)
-            os.rename(live, old)
+            if keep_last_k > 0:
+                _remove_checkpoint(ckpt_dir, f"{name}.{keep_last_k}")
+                for i in range(keep_last_k - 1, 0, -1):
+                    if os.path.isdir(os.path.join(ckpt_dir, f"{name}.{i}")):
+                        _shift_checkpoint(ckpt_dir, f"{name}.{i}",
+                                          f"{name}.{i + 1}")
+                _shift_checkpoint(ckpt_dir, name, f"{name}.1")
+            else:
+                # Clear .old only when a live checkpoint is about to
+                # replace it — if live is absent (recovering from a prior
+                # mid-commit crash), .old IS the only durable state and
+                # must survive until the new live lands.
+                shutil.rmtree(old, ignore_errors=True)
+                os.rename(live, old)
         os.rename(staging, live)
-        shutil.rmtree(old, ignore_errors=True)
+        if keep_last_k <= 0:
+            shutil.rmtree(old, ignore_errors=True)
         _write_meta(ckpt_dir, name, meta)
+        _write_manifest_bg(ckpt_dir, name)
+        if faultinject.fire("torn-checkpoint") is not None:
+            _tear_file(live)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(f"ckpt_commit_{name}")
@@ -112,19 +219,23 @@ def _land_pending() -> None:
 
 def wait_until_finished() -> None:
     """Block until any in-flight async save is durable (committed to its
-    live name, meta sidecar written). Call before reading a just-written
-    checkpoint and at the end of a run."""
+    live name, meta sidecar written, integrity manifest hashed). Call
+    before reading a just-written checkpoint and at the end of a run."""
     _checkpointer().wait_until_finished()
     _land_pending()
+    _join_manifest()
 
 
 def save(ckpt_dir: str, name: str, state: TrainState, meta: dict,
-         block: bool = True) -> None:
+         block: bool = True, keep_last_k: int = 0) -> None:
     """Write checkpoint + sidecar metadata. Multi-host safe: Orbax
     coordinates across processes; the sidecar + commit swap are
     process-0 with a cross-host barrier. ``block=False`` returns after
     staging; the background finalize, the commit swap, and the meta
     write complete on the next save/wait (see module docstring).
+    ``keep_last_k``: rotate that many displaced live checkpoints to
+    ``name.1``..``name.K`` instead of deleting them (the fallback
+    restore chain; 0 = legacy single-slot behavior).
     """
     global _pending_commit
     ckpt_dir = os.path.abspath(ckpt_dir)  # commit may land after a cwd
@@ -145,9 +256,11 @@ def save(ckpt_dir: str, name: str, state: TrainState, meta: dict,
     ckptr.save(staging, tree, force=True)
     if block:
         ckptr.wait_until_finished()
-        _commit(ckpt_dir, name, meta)
+        _commit(ckpt_dir, name, meta, keep_last_k)
+        _join_manifest()  # block=True promises full durability,
+        # manifest included (e.g. the preemption LAST before exit)
     else:
-        _pending_commit = (ckpt_dir, name, meta)
+        _pending_commit = (ckpt_dir, name, meta, keep_last_k)
 
 
 def _sidecar_meta(ckpt_dir: str, name: str) -> dict:
@@ -175,10 +288,17 @@ def restore(ckpt_dir: str, name: str,
     path = os.path.abspath(os.path.join(ckpt_dir, name))
     if not os.path.isdir(path):
         # Crash window between the commit renames: the previous durable
-        # checkpoint survives under name.old — restore it. (A leftover
-        # .staging dir is an INCOMPLETE write and is never restored.)
-        old = os.path.abspath(os.path.join(ckpt_dir, name + _OLD))
-        if not os.path.isdir(old):
+        # checkpoint survives under name.1 (keep_last_k rotation) or
+        # name.old (legacy single-slot commit) — newest-first: rotation
+        # is the live scheme, and a leftover .old from a pre-rotation
+        # run can be arbitrarily stale. (A leftover .staging dir is an
+        # INCOMPLETE write and is never restored.)
+        for prev_suffix in (".1", _OLD):
+            old = os.path.abspath(
+                os.path.join(ckpt_dir, name + prev_suffix))
+            if os.path.isdir(old):
+                break
+        else:
             return None
         print(f"NOTE: {path} missing (crash during checkpoint commit); "
               f"restoring the previous durable checkpoint {old}",
@@ -422,3 +542,81 @@ def restore(ckpt_dir: str, name: str,
           "(pre-{state,meta} format); re-saving will migrate it",
           flush=True)
     return state, _sidecar_meta(ckpt_dir, name)
+
+
+def fallback_candidates(ckpt_dir: str, name: str = LAST) -> list[str]:
+    """The restore chain, newest-first: live ``name``, the rotated
+    previous copies ``name.1``..``name.K`` (ascending = newest first),
+    the legacy ``name.old`` crash-window slot, then ``best`` — a stale
+    model beats a dead run."""
+    rotated = []
+    try:
+        pat = re.compile(re.escape(name) + r"\.(\d+)$")
+        for entry in os.listdir(ckpt_dir):
+            m = pat.match(entry)
+            if m and os.path.isdir(os.path.join(ckpt_dir, entry)):
+                rotated.append((int(m.group(1)), entry))
+    except OSError:
+        pass
+    chain = [name] + [e for _, e in sorted(rotated)] + [name + _OLD]
+    if name != BEST:
+        chain.append(BEST)
+    return chain
+
+
+def _verified_globally(ckpt_dir: str, cand: str) -> tuple[bool, str]:
+    """Manifest verification, hashed ONCE per pod: process 0 reads and
+    checksums the tree; its verdict is broadcast so every process walks
+    the identical fallback chain. (The Orbax restore that follows is a
+    collective — a split-brain verdict would hang it; and N processes
+    each re-hashing a multi-GB checkpoint over shared storage would
+    serialize minutes of redundant I/O into every requeue.)"""
+    if jax.process_count() == 1:
+        return integrity.verify(ckpt_dir, cand)
+    from jax.experimental import multihost_utils
+    if jax.process_index() == 0:
+        ok, detail = integrity.verify(ckpt_dir, cand)
+    else:
+        ok, detail = True, "verified on process 0"
+    agreed = bool(multihost_utils.broadcast_one_to_all(
+        np.asarray(1 if ok else 0, np.int32)))
+    return agreed, detail
+
+
+def restore_resilient(ckpt_dir: str, target: TrainState, name: str = LAST,
+                      ) -> tuple[TrainState, dict, str] | None:
+    """Restore the newest checkpoint that passes integrity verification,
+    walking the fallback chain (LAST -> previous LASTs -> BEST) past any
+    candidate whose manifest fails or whose Orbax restore throws — a
+    kill mid-commit or bit-rot on one directory must cost at most one
+    checkpoint interval, never the run. Returns ``(state, meta,
+    candidate_name)`` or None when nothing restorable exists."""
+    wait_until_finished()  # a just-written checkpoint must be durable
+    errors: list[str] = []
+    for cand in fallback_candidates(ckpt_dir, name):
+        path = os.path.join(ckpt_dir, cand)
+        if not os.path.isdir(path):
+            continue
+        ok, detail = _verified_globally(ckpt_dir, cand)
+        if not ok:
+            print(f"WARNING: checkpoint {path} failed integrity "
+                  f"verification ({detail}); trying the next fallback",
+                  flush=True)
+            errors.append(f"{cand}: {detail}")
+            continue
+        try:
+            restored = restore(ckpt_dir, cand, target)
+        except Exception as e:
+            print(f"WARNING: checkpoint {path} failed to restore "
+                  f"({type(e).__name__}: {e}); trying the next fallback",
+                  flush=True)
+            errors.append(f"{cand}: {type(e).__name__}")
+            continue
+        if restored is None:
+            continue
+        if cand != name:
+            print(f"NOTE: restored fallback checkpoint {path} "
+                  f"(earlier candidates failed: {'; '.join(errors)})",
+                  flush=True)
+        return restored[0], restored[1], cand
+    return None

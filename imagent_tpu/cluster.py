@@ -96,7 +96,12 @@ def expand_nodelist(nodelist: str) -> list[str]:
 
 def resolve_coordinator(nodelist: str) -> str:
     """First host of the nodelist — the reference's ``scontrol`` master
-    resolution (``imagenet.py:237-238``) without the subprocess."""
+    resolution (``imagenet.py:237-238``) without the subprocess.
+
+    The ``scontrol`` fallback retries with jittered backoff: at job
+    start every task of a large step hits the controller at once, and a
+    briefly-overloaded slurmctld answering one fork with a timeout must
+    not kill the whole pod's rendezvous."""
     try:
         hosts = expand_nodelist(nodelist)
         if hosts:
@@ -104,9 +109,15 @@ def resolve_coordinator(nodelist: str) -> str:
     except (ValueError, IndexError):
         pass
     # Fallback: ask scontrol like the reference does.
-    out = subprocess.run(
+    from imagent_tpu.resilience.retry import retry_call
+
+    out = retry_call(
+        subprocess.run,
         ["scontrol", "show", "hostnames", nodelist],
         capture_output=True, text=True, check=True,
+        attempts=4, base_delay=0.2, max_delay=5.0,
+        retry_on=(subprocess.CalledProcessError, OSError),
+        describe=f"scontrol show hostnames {nodelist}",
     ).stdout
     return out.split()[0]
 

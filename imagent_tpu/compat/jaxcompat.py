@@ -1,0 +1,25 @@
+"""JAX version compatibility shims.
+
+The framework targets the current ``jax.shard_map`` API (``check_vma``
+keyword). Older runtimes (<= 0.4.x) ship it as
+``jax.experimental.shard_map.shard_map`` with the keyword named
+``check_rep``. Pinning a floor would be the clean answer, but the
+deployment story (TPU VMs with preinstalled runtimes; this repo's own
+CI image) makes "run on the jax you were handed" the robust one — the
+semantic is identical, only the spelling moved.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` where available, else the experimental spelling
+    with ``check_vma`` mapped onto ``check_rep``."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)

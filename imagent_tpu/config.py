@@ -105,6 +105,26 @@ class Config:
     compile_cache: str = ""
     check_nans: bool = False  # debug flag (SURVEY §5 sanitizers)
 
+    # ---- resilience (imagent_tpu/resilience/) ----
+    # Non-finite step guard: bad steps are always skipped in-graph
+    # (train.py); after this many CONSECUTIVE skipped steps the engine
+    # rolls the state back to the last restorable checkpoint and
+    # replays (0 disables the rollback policy, not the skip).
+    max_bad_steps: int = 3
+    # Step-progress watchdog: if no train step completes within this
+    # many seconds (hung collective, wedged input pipeline), dump
+    # all-thread stacks and checkpoint-and-exit like a preemption
+    # (0 = off).
+    watchdog_secs: float = 0.0
+    # Rotated fallback copies of the LAST checkpoint (last.1..last.K)
+    # kept for the integrity-verified restore chain LAST -> previous
+    # LASTs -> BEST. 0 = single-slot legacy behavior.
+    keep_last_k: int = 1
+    # Fault-injection drills: arm named fault points, e.g.
+    # "nan-grads:after=4;times=4,stall-step:secs=6"
+    # (resilience/faultinject.py; also via IMAGENT_FAULTS env var).
+    faults: str = ""
+
     # ---- mesh geometry / parallelism strategies ----
     # Data-parallel size is inferred (devices / model_parallel). A model axis
     # is first-class in the mesh design (SURVEY §2c disposition) even though
@@ -256,6 +276,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compile-cache", type=str, default=c.compile_cache,
                    help="persistent XLA compilation cache directory")
     p.add_argument("--check-nans", action="store_true", default=False)
+    # Resilience subsystem.
+    p.add_argument("--max-bad-steps", type=int, default=c.max_bad_steps,
+                   help="consecutive non-finite (skipped) steps before "
+                        "rolling back to the last good checkpoint "
+                        "(0 disables rollback; the in-graph skip is "
+                        "always on)")
+    p.add_argument("--watchdog-secs", type=float, default=c.watchdog_secs,
+                   help="step-progress watchdog deadline: dump stacks "
+                        "and checkpoint-and-exit if no step completes "
+                        "in this many seconds (0 = off)")
+    p.add_argument("--keep-last-k", type=int, default=c.keep_last_k,
+                   help="rotated fallback copies of the LAST checkpoint "
+                        "for the verified restore chain (0 = one slot)")
+    p.add_argument("--faults", type=str, default=c.faults,
+                   help="arm fault-injection drill points, e.g. "
+                        "'nan-grads:after=4;times=4' (see "
+                        "resilience/faultinject.py)")
     p.add_argument("--model-parallel", type=int, default=c.model_parallel)
     p.add_argument("--seq-parallel", type=str, default=c.seq_parallel,
                    choices=["none", "ring", "ulysses"])

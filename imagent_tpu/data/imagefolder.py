@@ -31,6 +31,8 @@ from imagent_tpu.data.pipeline import (
 # derivation so both decode paths use identical fp32 constants.
 from imagent_tpu.native.loader import aug_params7
 from imagent_tpu.data.prefetch import iter_with_producer
+from imagent_tpu.resilience import faultinject
+from imagent_tpu.resilience.retry import retry_call
 
 _DEFAULT_P7 = aug_params7()
 
@@ -164,6 +166,30 @@ def _decode_one(path: str, aug_seed: int | None = None,
     return (arr - _W["mean"]) / _W["std"]  # Normalize (imagenet.py:283)
 
 
+def _decode_one_robust(path: str, aug_seed: int | None = None,
+                       aug_params=None) -> tuple[np.ndarray, bool]:
+    """``(image, ok)``: PIL decode with jittered-backoff retries on
+    OSError (transient NFS hiccups on networked dataset storage — PIL's
+    own decode errors are OSError subclasses too, costing two cheap
+    extra tries on a genuinely-bad file), then a zero-filled quarantine
+    fallback — one unreadable file must cost a logged counter, never a
+    multi-hour run. The ``corrupt-image`` fault point injects a failure
+    per ATTEMPT, so ``times=1`` drills the retry rescue and a larger
+    ``times`` drills the quarantine path."""
+
+    def attempt():
+        if faultinject.fire("corrupt-image") is not None:
+            raise OSError(f"injected corrupt-image fault: {path}")
+        return _decode_one(path, aug_seed, aug_params)
+
+    try:
+        return retry_call(attempt, attempts=3, base_delay=0.05,
+                          describe=f"decode {path}"), True
+    except Exception:
+        size = _W["size"]
+        return np.zeros((size, size, 3), np.float32), False
+
+
 
 
 class ImageFolderLoader:
@@ -186,6 +212,7 @@ class ImageFolderLoader:
         self._pool = None
         self._use_native = None  # resolved lazily in _ensure_pool
         self._warned_bad: set[str] = set()
+        self._quarantined = 0  # unreadable files zero-filled this epoch
 
     def _ensure_pool(self):
         if self._use_native is None:
@@ -212,8 +239,13 @@ class ImageFolderLoader:
         elif self._pool is None:
             _init_worker(self.cfg.image_size, self.cfg.mean, self.cfg.std)
 
-    def _decode_native(self, paths: list[str],
-                       seeds: np.ndarray | None) -> np.ndarray:
+    def _decode_native(self, paths: list[str], seeds: np.ndarray | None,
+                       warn_keys: list[str] | None = None) -> np.ndarray:
+        """``warn_keys``: operator-meaningful names for quarantine
+        warnings/dedup when ``paths`` are throwaway staging files (the
+        tar loader's /dev/shm uuids would otherwise warn once per batch
+        forever and name a deleted temp path)."""
+        keys = warn_keys if warn_keys is not None else paths
         from imagent_tpu import native
         images, ok = native.decode_resize_batch(
             paths, self.cfg.image_size, self.cfg.mean, self.cfg.std,
@@ -221,22 +253,53 @@ class ImageFolderLoader:
             # matching the PIL path (native 0 would mean all-cores)
             aug_seeds=seeds)
         for i in np.flatnonzero(~ok):  # per-file PIL rescue (slow path)
-            try:
-                images[i] = _decode_one(
-                    paths[i], int(seeds[i]) if seeds is not None else None)
+            img, decoded = _decode_one_robust(
+                paths[i], int(seeds[i]) if seeds is not None else None)
+            if decoded:
+                images[i] = img
                 if "rescue" not in self._warned_bad:
                     self._warned_bad.add("rescue")
-                    print(f"NOTE: {paths[i]} not native-decodable "
+                    print(f"NOTE: {keys[i]} not native-decodable "
                           "(jpeg/png/webp); PIL slow path", flush=True)
-            except Exception:
-                # Undecodable by both decoders: zero-fill rather than
-                # killing a multi-hour run over one bad file.
+            else:
+                # Undecodable by both decoders (after retries):
+                # zero-fill and quarantine-count rather than killing a
+                # multi-hour run over one bad file.
                 images[i] = 0.0
-                if paths[i] not in self._warned_bad:
-                    self._warned_bad.add(paths[i])
-                    print(f"WARNING: undecodable image {paths[i]}; "
-                          "substituting zeros", flush=True)
+                self._quarantine(keys[i])
         return images
+
+    def _quarantine(self, key: str) -> None:
+        self._quarantined += 1
+        if key not in self._warned_bad:
+            self._warned_bad.add(key)
+            print(f"WARNING: undecodable image {key}; "
+                  "substituting zeros", flush=True)
+
+    def _decode_pil_batch(self, paths: list[str],
+                          seeds: np.ndarray | None,
+                          warn_keys: list[str] | None = None) -> np.ndarray:
+        """PIL decode of a batch (pool or in-process) with per-file
+        retry + zero-fill quarantine — the shared non-native decode
+        body for both the loose-file and tar loaders."""
+        keys = warn_keys if warn_keys is not None else paths
+        args = [(p, int(seeds[i]) if seeds is not None else None)
+                for i, p in enumerate(paths)]
+        if self._pool is not None:
+            # Workers return (image, ok) — decode failures survive
+            # their in-worker retries as zero-filled quarantines,
+            # counted here in the parent (the pool processes don't
+            # share this object's state).
+            results = self._pool.starmap(_decode_one_robust, args,
+                                         chunksize=8)
+        else:
+            results = [_decode_one_robust(*a) for a in args]
+        for key, (_, decoded) in zip(keys, results):
+            if not decoded:
+                self._quarantine(key)
+        imgs = [img for img, _ in results]
+        return (np.stack(imgs) if imgs else np.zeros(
+            (0, self.cfg.image_size, self.cfg.image_size, 3), np.float32))
 
     def _aug_seeds(self, rows: np.ndarray, epoch: int) -> np.ndarray | None:
         """Per-sample uint64 seed, a pure function of (seed, epoch, dataset
@@ -259,14 +322,7 @@ class ImageFolderLoader:
         if self._use_native:
             images = self._decode_native(paths, seeds)
         else:
-            args = [(p, int(seeds[i]) if seeds is not None else None)
-                    for i, p in enumerate(paths)]
-            if self._pool is not None:
-                imgs = self._pool.starmap(_decode_one, args, chunksize=8)
-            else:
-                imgs = [_decode_one(*a) for a in args]
-            images = (np.stack(imgs) if imgs else np.zeros(
-                (0, self.cfg.image_size, self.cfg.image_size, 3), np.float32))
+            images = self._decode_pil_batch(paths, seeds)
         labels = self.labels[valid].astype(np.int32)
         if self.cfg.input_bf16:
             import ml_dtypes
@@ -277,6 +333,7 @@ class ImageFolderLoader:
         """Yields host-local batches; decode of batch k+1 overlaps the
         device's consumption of batch k via a bounded prefetch queue."""
         self._ensure_pool()
+        self._quarantined = 0
         idx = shard_indices(
             self.num_examples, epoch, self.cfg.seed, self.process_index,
             self.process_count, shuffle=self.train,
@@ -291,6 +348,12 @@ class ImageFolderLoader:
         # Shared cancellable producer/consumer protocol (prefetch.py):
         # unwinds the decode thread deterministically on early exit.
         yield from iter_with_producer(produce, maxsize=4)
+        if self._quarantined:
+            # Surfaced per epoch, not hidden: N zero-filled samples per
+            # epoch is a data-quality signal the operator must see.
+            print(f"WARNING: {self.split} epoch {epoch + 1}: "
+                  f"{self._quarantined} unreadable file(s) quarantined "
+                  "(zero-filled)", flush=True)
 
     def close(self):
         if self._pool is not None:

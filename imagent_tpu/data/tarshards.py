@@ -33,6 +33,7 @@ import numpy as np
 
 from imagent_tpu.config import Config
 from imagent_tpu.data.imagefolder import ImageFolderLoader
+from imagent_tpu.resilience.retry import retry_call
 
 _IMG_EXTS = (".jpg", ".jpeg", ".png", ".webp", ".bmp")
 
@@ -123,6 +124,7 @@ class TarShardLoader(ImageFolderLoader):
         self._pool = None
         self._use_native = None
         self._warned_bad: set[str] = set()
+        self._quarantined = 0
         shm = "/dev/shm"
         self._staging = tempfile.mkdtemp(
             prefix="imagent_tar_",
@@ -130,17 +132,36 @@ class TarShardLoader(ImageFolderLoader):
         self._fds: dict[int, int] = {}  # shard index -> O_RDONLY fd
 
     # ImageFolderLoader accesses self.paths[i]; provide staged files.
+    def _read_member(self, r: int) -> bytes:
+        """One ranged member read, reopening the shard's fd on failure —
+        the retry wrapper in ``_stage_rows`` drives it through transient
+        NFS errors (a stale handle on networked storage must cost a
+        reopen, not the run)."""
+        si = int(self._shard_of[r])
+        fd = self._fds.get(si)
+        if fd is None:
+            fd = os.open(self._shards[si], os.O_RDONLY)
+            self._fds[si] = fd
+        try:
+            return os.pread(fd, int(self._sizes[r]), int(self._offsets[r]))
+        except OSError:
+            # Drop the cached fd so the retry reopens it.
+            self._fds.pop(si, None)
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+            raise
+
     def _stage_rows(self, rows: np.ndarray) -> list[str]:
         # Ascending (shard, offset) = sequential reads within each shard.
         order = np.lexsort((self._offsets[rows], self._shard_of[rows]))
         staged: dict[int, str] = {}
         for r in rows[order]:
-            si = int(self._shard_of[r])
-            fd = self._fds.get(si)
-            if fd is None:
-                fd = os.open(self._shards[si], os.O_RDONLY)
-                self._fds[si] = fd
-            data = os.pread(fd, int(self._sizes[r]), int(self._offsets[r]))
+            data = retry_call(self._read_member, int(r), attempts=3,
+                              base_delay=0.05,
+                              describe=f"tar member read "
+                                       f"{self._names[int(r)]}")
             ext = os.path.splitext(str(self._names[r]))[1] or ".img"
             path = os.path.join(self._staging, f"{uuid.uuid4().hex}{ext}")
             with open(path, "wb") as f:
@@ -155,20 +176,16 @@ class TarShardLoader(ImageFolderLoader):
         staged = self._stage_rows(valid)
         seeds = self._aug_seeds(valid, epoch)
         self._ensure_pool()
+        # Quarantine warnings/dedup key on the real member name, not the
+        # throwaway /dev/shm staging uuid.
+        member_names = [str(self._names[int(r)]) for r in valid]
         try:
             if self._use_native:
-                images = self._decode_native(staged, seeds)
+                images = self._decode_native(staged, seeds,
+                                             warn_keys=member_names)
             else:
-                from imagent_tpu.data.imagefolder import _decode_one
-                args = [(p, int(seeds[i]) if seeds is not None else None)
-                        for i, p in enumerate(staged)]
-                if self._pool is not None:
-                    imgs = self._pool.starmap(_decode_one, args, chunksize=8)
-                else:
-                    imgs = [_decode_one(*a) for a in args]
-                images = (np.stack(imgs) if imgs else np.zeros(
-                    (0, self.cfg.image_size, self.cfg.image_size, 3),
-                    np.float32))
+                images = self._decode_pil_batch(staged, seeds,
+                                                warn_keys=member_names)
         finally:
             for p in staged:
                 try:

@@ -21,6 +21,7 @@ the reference's ``torch.cuda.synchronize()`` every step
 
 from __future__ import annotations
 
+import collections
 import os
 import signal
 import time
@@ -35,6 +36,8 @@ from imagent_tpu.config import Config
 from imagent_tpu.data import make_loaders
 from imagent_tpu.data.prefetch import device_prefetch
 from imagent_tpu.models import create_model
+from imagent_tpu.resilience import faultinject
+from imagent_tpu.resilience.watchdog import StepWatchdog
 from imagent_tpu.schedule import lr_for_epoch
 from imagent_tpu.train import (
     TrainState, create_train_state, make_eval_step, make_optimizer,
@@ -56,20 +59,45 @@ class PreemptionGuard:
     interrupted epoch. Multi-host note: Slurm delivers the signal to
     every task in the step, so all processes reach the collective
     checkpoint save together.
+
+    Handler hygiene: any previously-installed Python handler is CHAINED
+    (called after the flag is raised) and restored by ``uninstall()`` —
+    so embedding ``engine.run`` in a larger process (or running it
+    repeatedly in one test session) neither swallows the host's own
+    signal handling nor leaks this guard's past its run.
     """
 
     def __init__(self):
         self.requested = False
+        self._prev: dict = {}
         for sig in (signal.SIGTERM, getattr(signal, "SIGUSR1", None)):
             if sig is None:
                 continue
             try:
-                signal.signal(sig, self._on_signal)
+                self._prev[sig] = signal.signal(sig, self._on_signal)
             except ValueError:  # not on the main thread (e.g. tests)
                 pass
 
     def _on_signal(self, signum, frame):
         self.requested = True
+        prev = self._prev.get(signum)
+        if callable(prev):  # chain; SIG_IGN/SIG_DFL/None have no code
+            prev(signum, frame)
+
+    def request(self) -> None:
+        """Raise the stop flag programmatically (watchdog, drills)."""
+        self.requested = True
+
+    def uninstall(self) -> None:
+        """Put back whatever handlers were installed before this guard
+        (None — a non-Python handler — restores SIG_DFL, the closest
+        Python can get)."""
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, signal.SIG_DFL if prev is None else prev)
+            except ValueError:
+                pass
+        self._prev.clear()
 
     def __call__(self) -> bool:
         return self.requested
@@ -77,14 +105,19 @@ class PreemptionGuard:
 
 def _finalize(metric_buf: list) -> dict:
     """Sum per-step [loss_sum, top1, top5, n] vectors → epoch averages.
-    One host sync per epoch (not per step)."""
+    One host sync per epoch (not per step). ``bad_steps`` counts the
+    all-zero vectors the non-finite step guard emits for skipped
+    updates (``n == 0`` — impossible for a real step; train.py)."""
     if not metric_buf:
-        return {"loss": 0.0, "top1": 0.0, "top5": 0.0, "n": 0}
-    total = np.sum(np.stack([np.asarray(m) for m in metric_buf]), axis=0)
+        return {"loss": 0.0, "top1": 0.0, "top5": 0.0, "n": 0,
+                "bad_steps": 0}
+    arr = np.stack([np.asarray(m) for m in metric_buf])
+    total = arr.sum(axis=0)
     loss_sum, c1, c5, n = [float(x) for x in total]
     n = max(n, 1.0)
     return {"loss": loss_sum / n, "top1": c1 * 100.0 / n,
-            "top5": c5 * 100.0 / n, "n": int(n)}
+            "top5": c5 * 100.0 / n, "n": int(n),
+            "bad_steps": int((arr[:, 3] == 0).sum())}
 
 
 def _stop_agreed(stop_check, step_i: int) -> bool:
@@ -124,19 +157,34 @@ def _skip_batches(it, n: int):
             close()
 
 
+_GUARD_LAG = 2  # steps behind the dispatch the guard reads verdicts
+
+
 def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                     loader, epoch: int, lr: float, is_master: bool,
                     stop_check=None, start_step: int = 0,
-                    ) -> tuple[TrainState, dict, float, int]:
+                    watchdog: StepWatchdog | None = None,
+                    ) -> tuple[TrainState, dict, float, int, bool]:
     """One training epoch (reference ``train()``, ``imagenet.py:97-151``).
 
     ``start_step``: skip the first N batches — resuming an epoch that a
     preemption interrupted after N optimizer steps (the loader's order
     is deterministic per (seed, epoch), so the skipped batches are
     exactly the ones already applied).
-    Returns ``(state, metrics, seconds, interrupted_at)`` where
-    ``interrupted_at`` is -1 for a completed epoch, else the number of
-    optimizer steps applied when the stop fired.
+    Returns ``(state, metrics, seconds, interrupted_at, rollback)``
+    where ``interrupted_at`` is -1 for a completed epoch, else the
+    number of optimizer steps applied when the stop fired; ``rollback``
+    is True when ``cfg.max_bad_steps`` consecutive non-finite steps
+    were observed and the caller should restore the last good
+    checkpoint (``run``'s rollback loop).
+
+    Bad-step detection rides the per-step metric vector (an all-zero
+    vector, train.py) and is read ``_GUARD_LAG`` steps behind the
+    dispatch: the inspected step has (almost always) already completed,
+    so the read is a cheap D2H of 16 ready bytes, not a pipeline drain
+    — step dispatch stays async. The verdicts are replicated arrays, so
+    every host counts the same sequence and agrees on the rollback
+    decision without any extra collective.
     """
     t0 = time.time()
     data_time = AverageMeter("data")
@@ -144,34 +192,84 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
     lr_arr = np.float32(lr)
     interrupted_at = -1
     steps_done = start_step
+    max_bad = max(cfg.max_bad_steps, 0)
+    pending: collections.deque = collections.deque()
+    consec_bad = 0
+    rollback = False
+
+    def _observe_lagged(drain: bool = False) -> bool:
+        """Pop verdicts that are ``_GUARD_LAG`` steps old (all of them
+        when ``drain``); True once the consecutive-bad budget is hit."""
+        nonlocal consec_bad
+        while pending and (drain or len(pending) > _GUARD_LAG):
+            m = np.asarray(pending.popleft())
+            if m[3] == 0:
+                consec_bad += 1
+                if is_master:
+                    print(f"WARNING: non-finite step skipped "
+                          f"({consec_bad} consecutive; rollback at "
+                          f"{max_bad})", flush=True)
+                if consec_bad >= max_bad:
+                    return True
+            else:
+                consec_bad = 0
+        return False
+
     it = loader.epoch(epoch)
     if start_step:
         # NOT itertools.islice: islice has no close(), which would sever
         # device_prefetch's deterministic unwind of the loader's decode
         # thread exactly on the resumed-then-interrupted-again path.
         it = _skip_batches(it, start_step)
-    t_fetch = time.time()
-    # Batches arrive as device arrays staged one step ahead (H2D
-    # overlapped with the running step, data/prefetch.py).
-    for i, arrays in enumerate(device_prefetch(mesh, it)):
-        step_i = start_step + i
-        if _stop_agreed(stop_check, step_i):
-            interrupted_at = steps_done
-            break
-        data_time.update(time.time() - t_fetch)
-        images, labels = arrays
-        state, metrics = train_step(state, images, labels, lr_arr)
-        metric_buf.append(metrics)
-        steps_done += 1
-        if is_master and cfg.log_every and (step_i + 1) % cfg.log_every == 0:
-            m = np.asarray(metrics)  # syncs a step already in flight
-            print(f"  epoch {epoch + 1} step {step_i + 1}/"
-                  f"{loader.steps_per_epoch} loss "
-                  f"{m[0] / max(m[3], 1):.4f} data_time {data_time.avg:.3f}s",
-                  flush=True)
+    if watchdog is not None:
+        watchdog.arm()
+    try:
         t_fetch = time.time()
+        # Batches arrive as device arrays staged one step ahead (H2D
+        # overlapped with the running step, data/prefetch.py).
+        for i, arrays in enumerate(device_prefetch(mesh, it)):
+            step_i = start_step + i
+            if _stop_agreed(stop_check, step_i):
+                interrupted_at = steps_done
+                break
+            data_time.update(time.time() - t_fetch)
+            images, labels = arrays
+            if faultinject.active():  # drills only; falsy no-op otherwise
+                f = faultinject.fire("stall-step")
+                if f is not None:  # hung collective / wedged input stand-in
+                    time.sleep(float(f.get("secs", 5.0)))
+                if faultinject.fire("nan-grads") is not None:
+                    # Poison the batch: loss and every gradient go NaN,
+                    # driving the in-graph skip + rollback path.
+                    images = images * jnp.float32(np.nan)
+                if faultinject.fire("sigterm") is not None:
+                    os.kill(os.getpid(), signal.SIGTERM)
+            state, metrics = train_step(state, images, labels, lr_arr)
+            metric_buf.append(metrics)
+            steps_done += 1
+            if max_bad:
+                pending.append(metrics)
+                if _observe_lagged():
+                    rollback = True
+                    break
+            if watchdog is not None:
+                watchdog.beat()
+            if is_master and cfg.log_every \
+                    and (step_i + 1) % cfg.log_every == 0:
+                m = np.asarray(metrics)  # syncs a step already in flight
+                print(f"  epoch {epoch + 1} step {step_i + 1}/"
+                      f"{loader.steps_per_epoch} loss "
+                      f"{m[0] / max(m[3], 1):.4f} "
+                      f"data_time {data_time.avg:.3f}s",
+                      flush=True)
+            t_fetch = time.time()
+        if max_bad and not rollback and interrupted_at < 0:
+            rollback = _observe_lagged(drain=True)
+    finally:
+        if watchdog is not None:
+            watchdog.disarm()
     epoch_metrics = _finalize(metric_buf)  # the only mandatory sync point
-    return state, epoch_metrics, time.time() - t0, interrupted_at
+    return state, epoch_metrics, time.time() - t0, interrupted_at, rollback
 
 
 def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
@@ -287,12 +385,43 @@ def run(cfg: Config, stop_check=None) -> dict:
 
     ``stop_check``: optional zero-arg callable polled each step; when it
     returns True the run checkpoints and exits cleanly (defaults to a
-    ``PreemptionGuard`` on SIGTERM/SIGUSR1)."""
+    ``PreemptionGuard`` on SIGTERM/SIGUSR1). With ``--watchdog-secs``
+    a step-progress watchdog rides the same stop path: a wedged run
+    (hung collective, stuck input pipeline) dumps all-thread stacks,
+    checkpoints LAST, and exits cleanly for the scheduler to requeue.
+    Fault drills: ``--faults`` / ``IMAGENT_FAULTS`` arm named fault
+    points (resilience/faultinject.py)."""
     # cfg.backend selects the PJRT platform: "tpu" = runtime auto-select;
     # "cpu"/"gpu" are forced, overriding any environment preset.
     senv = cluster.initialize(cfg.backend or None)
+    faultinject.configure(cfg.faults or None)
+    if faultinject.active() and jax.process_index() == 0:
+        print(f"FAULT DRILL: fault points armed ({cfg.faults or 'env'})",
+              flush=True)
+    guard = None
     if stop_check is None:
-        stop_check = PreemptionGuard()
+        stop_check = guard = PreemptionGuard()
+    watchdog = None
+    if cfg.watchdog_secs > 0:
+        watchdog = StepWatchdog(cfg.watchdog_secs)
+        base_stop = stop_check
+        stop_check = lambda: watchdog.fired or base_stop()  # noqa: E731
+    try:
+        return _run(cfg, stop_check, senv, watchdog)
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+        if guard is not None:
+            guard.uninstall()
+
+
+# Rollback-to-checkpoint attempts before declaring the run unrecoverable
+# (persistent non-finite gradients re-poison every replay — a config
+# problem, not a transient).
+_MAX_ROLLBACKS = 3
+
+
+def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
     if cfg.compile_cache:
         jax.config.update("jax_compilation_cache_dir",
                           os.path.abspath(cfg.compile_cache))
@@ -564,55 +693,70 @@ def run(cfg: Config, stop_check=None) -> dict:
             jitter_fn=jitter_fn)
         eval_step = make_eval_step(model, mesh, state_specs)
 
+    def _resume_point(meta: dict) -> tuple[int, int, float, float, int]:
+        """(start_epoch, resume_step, best_top1, best_top5, best_epoch)
+        from checkpoint meta, validating a mid-epoch checkpoint's
+        loader-order fingerprint. Shared by --resume and the bad-step
+        rollback path."""
+        start_epoch = int(meta.get("epoch", -1)) + 1
+        # Preemption checkpoints record how many optimizer steps of
+        # the interrupted epoch are already applied; resume skips
+        # exactly those batches (deterministic loader order).
+        resume_step = int(meta.get("resume_step", 0))
+        if resume_step > 0:
+            # The skipped-batch bookkeeping is only valid on the
+            # loader order it was recorded under — a pure function
+            # of (seed, epoch, process_count, global_batch).
+            recorded = {"global_batch": int(meta.get("global_batch", 0)),
+                        "process_count": int(
+                            meta.get("process_count", 0)),
+                        "seed": int(meta.get("seed", -1))}
+            current = {"global_batch": global_batch,
+                       "process_count": jax.process_count(),
+                       "seed": cfg.seed}
+            if recorded["global_batch"] == 0:
+                if is_master:
+                    print("WARNING: mid-epoch checkpoint predates "
+                          "topology recording; cannot verify the "
+                          "resumed loader order matches", flush=True)
+            elif recorded != current:
+                raise ValueError(
+                    f"mid-epoch resume topology mismatch: checkpoint "
+                    f"was written under {recorded} but this run is "
+                    f"{current} — resuming would skip the wrong "
+                    f"batches (some gradients twice, others never). "
+                    f"Restart the epoch (delete the 'last' "
+                    f"checkpoint's resume_step) or match the "
+                    f"original topology.")
+            if (train_loader is not None
+                    and resume_step >= train_loader.steps_per_epoch):
+                raise ValueError(
+                    f"recorded resume_step {resume_step} >= "
+                    f"{train_loader.steps_per_epoch} steps/epoch — "
+                    "the dataset or batch geometry changed since "
+                    "the interrupted run")
+        return (start_epoch, resume_step,
+                float(meta.get("best_top1", 0.0)),
+                float(meta.get("best_top5", 0.0)),
+                int(meta.get("best_epoch", -1)))
+
     start_epoch, best_top1, best_top5, best_epoch = 0, 0.0, 0.0, -1
     resume_step = 0
     if cfg.resume:
-        restored = ckpt_lib.restore(cfg.ckpt_dir, ckpt_lib.LAST, state)
+        # Fallback-chain restore: a torn/corrupt LAST (kill mid-commit,
+        # bit-rot) falls back to the previous LAST, then BEST, instead
+        # of stranding the requeued run (resilience/integrity.py).
+        restored = ckpt_lib.restore_resilient(cfg.ckpt_dir, state)
         if restored is not None:
-            state, meta = restored
+            state, meta, src = restored
             state = place_state(state, mesh, state_specs)
-            start_epoch = int(meta.get("epoch", -1)) + 1
-            # Preemption checkpoints record how many optimizer steps of
-            # the interrupted epoch are already applied; resume skips
-            # exactly those batches (deterministic loader order).
-            resume_step = int(meta.get("resume_step", 0))
-            if resume_step > 0:
-                # The skipped-batch bookkeeping is only valid on the
-                # loader order it was recorded under — a pure function
-                # of (seed, epoch, process_count, global_batch).
-                recorded = {"global_batch": int(meta.get("global_batch", 0)),
-                            "process_count": int(
-                                meta.get("process_count", 0)),
-                            "seed": int(meta.get("seed", -1))}
-                current = {"global_batch": global_batch,
-                           "process_count": jax.process_count(),
-                           "seed": cfg.seed}
-                if recorded["global_batch"] == 0:
-                    if is_master:
-                        print("WARNING: mid-epoch checkpoint predates "
-                              "topology recording; cannot verify the "
-                              "resumed loader order matches", flush=True)
-                elif recorded != current:
-                    raise ValueError(
-                        f"mid-epoch resume topology mismatch: checkpoint "
-                        f"was written under {recorded} but this run is "
-                        f"{current} — resuming would skip the wrong "
-                        f"batches (some gradients twice, others never). "
-                        f"Restart the epoch (delete the 'last' "
-                        f"checkpoint's resume_step) or match the "
-                        f"original topology.")
-                if resume_step >= train_loader.steps_per_epoch:
-                    raise ValueError(
-                        f"recorded resume_step {resume_step} >= "
-                        f"{train_loader.steps_per_epoch} steps/epoch — "
-                        "the dataset or batch geometry changed since "
-                        "the interrupted run")
-            best_top1 = float(meta.get("best_top1", 0.0))
-            best_top5 = float(meta.get("best_top5", 0.0))
-            best_epoch = int(meta.get("best_epoch", -1))
+            (start_epoch, resume_step, best_top1, best_top5,
+             best_epoch) = _resume_point(meta)
             if is_master:
                 print(f"resumed from epoch {start_epoch}"
-                      + (f" step {resume_step}" if resume_step else ""),
+                      + (f" step {resume_step}" if resume_step else "")
+                      + (f" (fallback checkpoint {src})"
+                         if src != ckpt_lib.LAST else ""),
                       flush=True)
 
     logger = TrainLogger(cfg.log_dir, is_master)
@@ -647,14 +791,71 @@ def run(cfg: Config, stop_check=None) -> dict:
                 "best_epoch": start_epoch - 1,
                 "total_minutes": (time.time() - run_t0) / 60.0,
                 "final_train": train_m, "final_val": val_m,
-                "preempted": False}
+                "preempted": False, "rollbacks": 0}
 
-    for epoch in range(start_epoch, cfg.epochs):
+    rollbacks = 0        # total, reported in the summary
+    rollback_streak = 0  # consecutive incidents — the give-up budget
+    epoch = start_epoch
+    while epoch < cfg.epochs:
         lr = lr_for_epoch(cfg, epoch)
-        state, train_m, train_t, interrupted_at = train_one_epoch(
-            cfg, mesh, train_step, state, train_loader, epoch, lr,
-            is_master, stop_check, resume_step)
+        state, train_m, train_t, interrupted_at, want_rollback = \
+            train_one_epoch(
+                cfg, mesh, train_step, state, train_loader, epoch, lr,
+                is_master, stop_check, resume_step, watchdog)
         resume_step = 0  # only the first resumed epoch skips batches
+        if not want_rollback:
+            # An epoch got through without tripping the guard: any
+            # earlier incident was genuinely transient. The give-up
+            # budget is per incident-STREAK, not per run — three
+            # isolated recovered transients across 100 epochs must not
+            # kill a healthy job on the fourth.
+            rollback_streak = 0
+        if want_rollback:
+            # --max-bad-steps consecutive non-finite steps: the updates
+            # were all skipped in-graph, so the live state is not
+            # poisoned — but something is persistently wrong (data
+            # shard, numerics). Roll back to the last restorable
+            # checkpoint and replay rather than abort: a transient
+            # (one corrupt shard served once, a flaky host) costs one
+            # checkpoint interval instead of the run.
+            rollbacks += 1
+            rollback_streak += 1
+            if rollback_streak > _MAX_ROLLBACKS:
+                raise RuntimeError(
+                    f"non-finite steps persisted through {_MAX_ROLLBACKS} "
+                    "consecutive rollbacks — giving up (check data / lr "
+                    "/ bf16 ranges; the fault reproduces on every replay)")
+            restored = ckpt_lib.restore_resilient(cfg.ckpt_dir, state)
+            if restored is None:
+                # Nothing to roll back to — but the in-graph guard
+                # skipped every bad update, so the live state is NOT
+                # poisoned. Killing an intact run because --save-model
+                # is off would be strictly worse than pressing on; skip
+                # the rest of this epoch (its remaining batches would
+                # re-fire whatever tripped the guard) and continue,
+                # still bounded by the rollback budget above.
+                if is_master:
+                    print(f"WARNING: {cfg.max_bad_steps} consecutive "
+                          f"non-finite steps in epoch {epoch + 1} and "
+                          "no checkpoint to roll back to (--save-model "
+                          "off?). State is unpoisoned (updates were "
+                          "skipped in-graph); abandoning the rest of "
+                          f"this epoch ({rollback_streak}/"
+                          f"{_MAX_ROLLBACKS} consecutive strikes "
+                          "before giving up)", flush=True)
+                epoch += 1
+                continue
+            state, meta, src = restored
+            state = place_state(state, mesh, state_specs)
+            (epoch, resume_step, best_top1, best_top5,
+             best_epoch) = _resume_point(meta)
+            if is_master:
+                print(f"ROLLBACK {rollback_streak}/{_MAX_ROLLBACKS}: "
+                      f"restored checkpoint '{src}', replaying from "
+                      f"epoch {epoch + 1}"
+                      + (f" step {resume_step}" if resume_step else ""),
+                      flush=True)
+            continue
         if interrupted_at >= 0:
             # Preemption: persist the mid-epoch state, recording how many
             # of this epoch's steps it contains — --resume skips exactly
@@ -662,7 +863,8 @@ def run(cfg: Config, stop_check=None) -> dict:
             ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.LAST, state, {
                 "epoch": epoch - 1, "resume_step": interrupted_at,
                 "best_top1": best_top1, "best_top5": best_top5,
-                "best_epoch": best_epoch, **topo_meta})
+                "best_epoch": best_epoch, **topo_meta},
+                keep_last_k=cfg.keep_last_k)
             if is_master:
                 print(f"preemption signal: checkpointed epoch {epoch + 1} "
                       f"at step {interrupted_at}; exiting cleanly "
@@ -688,10 +890,14 @@ def run(cfg: Config, stop_check=None) -> dict:
             ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.LAST, state, {
                 "epoch": epoch, "best_top1": best_top1,
                 "best_top5": best_top5, "best_epoch": best_epoch,
-                **topo_meta}, block=False)
+                **topo_meta}, block=False, keep_last_k=cfg.keep_last_k)
+        if is_master and train_m.get("bad_steps"):
+            print(f"  epoch {epoch + 1}: {train_m['bad_steps']} "
+                  "non-finite step(s) skipped", flush=True)
         logger.epoch_summary(epoch, lr, train_m,
                              val_m if did_eval else None, train_t, val_t)
         logger.scalars(epoch, lr, train_m, val_m if did_eval else None)
+        epoch += 1
 
     ckpt_lib.wait_until_finished()  # land any in-flight async save
     if cfg.profile and is_master:
@@ -707,4 +913,4 @@ def run(cfg: Config, stop_check=None) -> dict:
     return {"best_top1": best_top1, "best_top5": best_top5,
             "best_epoch": best_epoch, "total_minutes": total_min,
             "final_train": train_m, "final_val": val_m,
-            "preempted": preempted}
+            "preempted": preempted, "rollbacks": rollbacks}

@@ -1,0 +1,30 @@
+"""Resilience subsystem: fault tolerance for long multi-host runs.
+
+The recovery *mechanism* (full-state Orbax checkpoints, preemption
+guard, ``--resume``) predates this package; what it adds is the
+*detection and tolerance* layer the reference entirely lacks (it loses
+everything on any rank failure — SURVEY §5 "Failure detection",
+``imagenet.py:388-392``):
+
+* ``faultinject`` — config/env-driven registry of named fault points
+  that production code queries at near-zero cost when disabled, and
+  that the fault-drill tests use to exercise every recovery path on the
+  CPU backend (``tests/test_fault_drills.py``);
+* ``retry`` — jittered exponential backoff for fragile I/O edges
+  (per-file dataset reads, ``scontrol`` forks);
+* ``watchdog`` — a step-progress watchdog that dumps all-thread stacks
+  and requests a clean checkpoint-and-exit when no train step completes
+  within a deadline (hung collective, wedged input pipeline);
+* ``integrity`` — per-file checksum manifests for checkpoint
+  directories, verified on restore so a torn write or bit-rot falls
+  back to an older good checkpoint instead of stranding the run.
+
+The fourth pillar — the non-finite step guard — lives in the jitted
+step itself (``train.py``: bad updates are skipped in-graph, the flag
+rides the per-step metric vector as ``n == 0``) with the rollback
+policy in ``engine.py``.
+"""
+
+from imagent_tpu.resilience import faultinject  # noqa: F401
+from imagent_tpu.resilience.retry import retry_call  # noqa: F401
+from imagent_tpu.resilience.watchdog import StepWatchdog  # noqa: F401

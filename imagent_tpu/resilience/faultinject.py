@@ -1,0 +1,166 @@
+"""Fault injection: named fault points production code queries cheaply.
+
+The recovery paths this framework ships (non-finite step guard +
+rollback, checkpoint integrity + fallback restore, the step-progress
+watchdog, decode quarantine) are exactly the paths that never execute
+in a healthy run — untested recovery code is broken recovery code.
+This registry lets tests (and operators running drills on a live pod)
+arm specific failures by name without touching the production code
+paths around them.
+
+Spec grammar (``--faults`` flag or ``IMAGENT_FAULTS`` env var)::
+
+    name[:key=val[;key=val...]][,name2...]
+
+e.g. ``nan-grads:after=4;times=4,stall-step:after=2;secs=6``.
+
+Every fault point understands two windowing params counted in calls to
+``fire(name)`` at that site: ``after`` (skip the first N fires, default
+0) and ``times`` (stay active for N fires, default 1). Extra params are
+site-specific and read via ``Fault.get``.
+
+Registered fault points (grep for ``faultinject.fire``):
+
+* ``nan-grads`` (engine): poisons the step's input batch with NaN, so
+  the loss/gradients go non-finite — drives the in-graph skip guard
+  and the rollback path.
+* ``stall-step`` (engine): sleeps ``secs`` (default 5) inside the epoch
+  loop — drives the step-progress watchdog.
+* ``torn-checkpoint`` (checkpoint): truncates one data file of the
+  just-committed checkpoint — drives manifest verification and the
+  fallback restore chain.
+* ``corrupt-image`` (data): raises on a decode attempt — drives the
+  retry/backoff path (``times=1``: the retry succeeds) and the
+  quarantine path (``times`` >= the retry budget).
+* ``sigterm`` (engine): calls ``os.kill(os.getpid(), SIGTERM)`` before
+  a step — drives the PreemptionGuard checkpoint-and-exit path without
+  an external killer.
+
+Cost discipline: when nothing is configured, ``fire`` is one falsy
+check on a module dict — safe to call per step / per file in hot
+paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+
+ENV_VAR = "IMAGENT_FAULTS"
+
+_REGISTRY: dict[str, "Fault"] = {}
+_configured = False
+_lock = threading.Lock()
+
+
+@dataclasses.dataclass
+class Fault:
+    """One armed fault point. ``fired`` counts ``fire()`` calls at the
+    site; the fault is active on calls ``after < n <= after + times``."""
+
+    name: str
+    after: int = 0
+    times: int = 1
+    params: dict = dataclasses.field(default_factory=dict)
+    fired: int = 0
+
+    def get(self, key: str, default=None):
+        return self.params.get(key, default)
+
+
+def _parse_value(raw: str):
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        return raw
+
+
+def parse_spec(spec: str) -> dict[str, Fault]:
+    """Parse the spec grammar; raises ValueError on malformed input so a
+    typo in a drill config fails loudly, not silently-disarmed."""
+    faults: dict[str, Fault] = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        name, _, paramstr = part.partition(":")
+        name = name.strip()
+        if not name:
+            raise ValueError(f"fault spec {spec!r}: empty fault name")
+        params = {}
+        for kv in filter(None, (p.strip() for p in paramstr.split(";"))):
+            key, sep, val = kv.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"fault spec {spec!r}: param {kv!r} is not key=val")
+            params[key.strip()] = _parse_value(val.strip())
+        faults[name] = Fault(
+            name=name,
+            after=int(params.pop("after", 0)),
+            times=int(params.pop("times", 1)),
+            params=params,
+        )
+    return faults
+
+
+def configure(spec: str | None = None) -> None:
+    """(Re)arm the registry from ``spec``; None reads ``IMAGENT_FAULTS``.
+    An empty spec disarms everything (the production default).
+
+    An explicit spec is also exported to ``IMAGENT_FAULTS``: the
+    registry is per-process, and the data loaders' spawn-context pool
+    workers are fresh interpreters that pick the spec up from the
+    inherited environment (``_ensure_configured``) — otherwise a
+    ``--faults corrupt-image`` drill on the PIL pool path would arm
+    nothing where the decoding actually happens."""
+    global _configured
+    with _lock:
+        if spec is None:
+            spec = os.environ.get(ENV_VAR, "")
+        elif spec:
+            os.environ[ENV_VAR] = spec
+        else:
+            os.environ.pop(ENV_VAR, None)
+        _REGISTRY.clear()
+        _REGISTRY.update(parse_spec(spec))
+        _configured = True
+
+
+def reset() -> None:
+    """Disarm all fault points (test teardown)."""
+    configure("")
+
+
+def active() -> bool:
+    """True if any fault point is armed (diagnostic banners)."""
+    _ensure_configured()
+    return bool(_REGISTRY)
+
+
+def _ensure_configured() -> None:
+    # Lazy env pickup: spawned data-loader workers (fresh interpreters)
+    # inherit IMAGENT_FAULTS without anyone calling configure() there.
+    global _configured
+    if not _configured:
+        configure(None)
+
+
+def fire(name: str) -> Fault | None:
+    """Query a fault point. Returns the Fault while it is active, else
+    None. Near-zero cost when nothing is armed."""
+    if not _REGISTRY:
+        if _configured or not os.environ.get(ENV_VAR):
+            return None
+        _ensure_configured()
+        if not _REGISTRY:
+            return None
+    with _lock:
+        f = _REGISTRY.get(name)
+        if f is None:
+            return None
+        f.fired += 1
+        if f.after < f.fired <= f.after + f.times:
+            return f
+        return None

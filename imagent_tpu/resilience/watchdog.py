@@ -1,0 +1,150 @@
+"""Step-progress watchdog: detect a wedged run and get a checkpoint out.
+
+Failure mode (SURVEY §5 "Failure detection"): a hung collective (one
+pod worker dead or deadlocked) or a wedged input pipeline stalls the
+epoch loop forever — Slurm eventually walltime-kills the job with no
+diagnosis and (in the reference) no checkpoint. The watchdog observes
+step-completion heartbeats from the epoch loop; if no step completes
+within the deadline it (1) dumps every thread's stack to stderr — the
+post-mortem that distinguishes "stuck in a psum" from "stuck in
+tar-shard staging" — and (2) raises its ``fired`` flag, which
+``engine.run`` polls exactly like a preemption notice: checkpoint LAST
+at an agreed step boundary, exit cleanly, let Slurm requeue.
+
+Arming discipline: the epoch loop arms the watchdog for the duration of
+an epoch's steps and disarms it around eval/checkpoint phases (their
+latency is legitimately unbounded — first-step compilation alone can
+take minutes). The deadline countdown starts at the FIRST heartbeat of
+an armed window, so step-0 compilation never trips it; the cost is
+that a hang *before* the first step of an epoch is caught only by the
+cluster's own walltime, an accepted trade.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+
+
+def dump_all_stacks(out=None) -> None:
+    """Write every live thread's Python stack to ``out`` (default: the
+    CURRENT sys.stderr, resolved at call time so redirected/captured
+    streams see it). Pure-Python (not faulthandler) so the dump carries
+    thread names and lands in the same stream the run logs to."""
+    out = out if out is not None else sys.stderr
+    names = {t.ident: t.name for t in threading.enumerate()}
+    lines = ["", "=" * 70,
+             "watchdog: all-thread stack dump", "=" * 70]
+    for tid, frame in sys._current_frames().items():
+        lines.append(f"--- thread {names.get(tid, '?')} (ident {tid}) ---")
+        lines.extend(l.rstrip("\n")
+                     for l in traceback.format_stack(frame))
+    lines.append("=" * 70)
+    print("\n".join(lines), file=out, flush=True)
+
+
+class StepWatchdog:
+    """Daemon thread watching heartbeats from the epoch loop.
+
+    ``arm()`` at epoch start, ``beat()`` after each completed step,
+    ``disarm()`` around unbounded phases, ``stop()`` at run end. When
+    armed and the gap since the last beat exceeds ``deadline_secs``,
+    sets ``fired`` (polled by the engine's stop path) and dumps all
+    thread stacks — once; the flag stays up until the run exits.
+
+    Escalation: ``fired`` only helps if the epoch loop is still alive to
+    poll it. On a PERMANENT hang (the main thread blocked inside a dead
+    collective) the loop never polls again — so if no step completes
+    and ``stop()`` is not called within a grace window after firing
+    (``max(2 x deadline, 60s)``), the watchdog hard-exits the process
+    (``os._exit``) with a distinctive code so the scheduler requeues
+    now instead of after the walltime. A resumed heartbeat cancels the
+    escalation (the stall was transient; the clean checkpoint-and-exit
+    path takes over).
+    """
+
+    ESCALATE_EXIT_CODE = 86
+
+    def __init__(self, deadline_secs: float, out=None):
+        if deadline_secs <= 0:
+            raise ValueError("watchdog deadline must be positive")
+        self.deadline = float(deadline_secs)
+        self.fired = False
+        self._out = out
+        self._armed = False
+        self._deadline_at: float | None = None  # None = not counting
+        self._escalate_at: float | None = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._watch, name="step-watchdog", daemon=True)
+        self._thread.start()
+
+    def arm(self) -> None:
+        """Start a monitored window; the countdown begins at the first
+        ``beat()`` (see module docstring on compilation)."""
+        with self._lock:
+            self._armed = True
+            self._deadline_at = None
+
+    def beat(self) -> None:
+        """A step completed: push the deadline out. Progress after a
+        fire cancels the hard-exit escalation — the clean
+        checkpoint-and-exit path can run now."""
+        with self._lock:
+            if self._armed:
+                self._deadline_at = time.monotonic() + self.deadline
+            self._escalate_at = None
+
+    def disarm(self) -> None:
+        """Leave the monitored window (eval / checkpoint / run end)."""
+        with self._lock:
+            self._armed = False
+            self._deadline_at = None
+            self._escalate_at = None
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def _watch(self) -> None:
+        poll = min(max(self.deadline / 4.0, 0.05), 1.0)
+        while not self._stop.wait(poll):
+            escalate = False
+            with self._lock:
+                now = time.monotonic()
+                expired = (self._deadline_at is not None
+                           and now > self._deadline_at
+                           and not self.fired)
+                if expired:
+                    self.fired = True
+                    self._deadline_at = None
+                    self._escalate_at = now + max(2.0 * self.deadline,
+                                                  60.0)
+                elif (self._escalate_at is not None
+                        and now > self._escalate_at):
+                    escalate = True
+            out = self._out if self._out is not None else sys.stderr
+            if expired:
+                print(f"WATCHDOG: no train step completed within "
+                      f"{self.deadline:.1f}s — dumping stacks and "
+                      f"requesting checkpoint-and-exit",
+                      file=out, flush=True)
+                dump_all_stacks(self._out)
+            if escalate:
+                # The epoch loop never polled the flag: the main thread
+                # is permanently wedged (dead collective). Hard-exit so
+                # the scheduler requeues NOW, not at walltime.
+                print("WATCHDOG: still no progress after the grace "
+                      "window — hard-exiting for scheduler requeue "
+                      f"(code {self.ESCALATE_EXIT_CODE})",
+                      file=out, flush=True)
+                try:
+                    sys.stderr.flush()
+                    sys.stdout.flush()
+                except Exception:
+                    pass
+                os._exit(self.ESCALATE_EXIT_CODE)

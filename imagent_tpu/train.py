@@ -43,7 +43,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from imagent_tpu.cluster import DATA_AXIS, MODEL_AXIS
+from imagent_tpu.cluster import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+from imagent_tpu.compat.jaxcompat import shard_map
 from imagent_tpu.ops import softmax_cross_entropy
 from imagent_tpu.parallel import pmean_tree
 from imagent_tpu.utils.metrics import topk_correct
@@ -238,6 +239,28 @@ def masked_eval_metrics(logits, labels, mask) -> jnp.ndarray:
     return jnp.stack([per_sample.sum(), c1, c5, mask.sum()])
 
 
+def _nonfinite_local(grads, metrics) -> jnp.ndarray:
+    """Scalar bool: this shard's step produced a non-finite loss or
+    gradient. One fp32 square-sum per leaf — non-finite values propagate
+    into the norm, so a single reduced scalar answers for the whole
+    tree (an fp32 overflow of the norm itself flags the step too, which
+    is the right call: such a step is garbage either way)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    gnorm2 = sum((jnp.sum(jnp.square(g.astype(jnp.float32)))
+                  for g in leaves), jnp.float32(0.0))
+    return jnp.logical_not(jnp.isfinite(gnorm2)
+                           & jnp.all(jnp.isfinite(metrics)))
+
+
+def _skip_if_bad(ok, new_tree, old_tree):
+    """Per-leaf select: keep the freshly-computed leaf on a finite step,
+    the pre-step leaf otherwise — the in-graph half of the non-finite
+    step guard (no host sync; the engine reads the verdict from the
+    zeroed metric vector, see ``make_train_step``)."""
+    return jax.tree.map(lambda new, old: jnp.where(ok, new, old),
+                        new_tree, old_tree)
+
+
 def _grads_and_metrics(grad_fn, params, batch_stats, images, labels):
     """One batch: (grads, [loss_sum, top1, top5, n], new_batch_stats).
     On mixed batches the loss is the mixed objective; top-k counts
@@ -302,6 +325,15 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     ``metrics`` is a replicated ``[loss_sum, top1_cnt, top5_cnt, n]``
     vector; the host-side meters divide (``AverageMeter`` semantics,
     ``imagenet.py:143-145``) without forcing a device sync.
+
+    Non-finite step guard (resilience subsystem): when the loss or any
+    gradient is NaN/Inf, the update is skipped IN-GRAPH (params,
+    optimizer slots, BN stats and EMA all keep their pre-step values;
+    ``step`` still advances) and the metric vector comes back all-zero —
+    ``n == 0`` is impossible for a real step, so it doubles as the
+    bad-step flag without changing the vector's shape or adding any
+    per-step host sync. Rollback policy on repeated bad steps lives in
+    ``engine.train_one_epoch``.
 
     ``grad_accum`` splits each device's batch into that many sequential
     micro-batches inside the compiled step (``lax.scan``): one optimizer
@@ -398,6 +430,17 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
             from imagent_tpu.parallel.pipeline import normalize_region_grads
             grads = normalize_region_grads(grads, state_specs.params, axis)
 
+        # Non-finite step guard (resilience subsystem): one NaN step must
+        # not poison the weights for the rest of a 100-epoch run. The
+        # verdict is agreed across ALL mesh axes (model/pipe shards hold
+        # different param slices, so one shard can go non-finite alone;
+        # a split-brain select would desynchronize the replicas), then
+        # the update is skipped in-graph — no host sync; the engine
+        # reads the verdict from the zeroed metric vector (n == 0, which
+        # no real step can produce) and handles rollback policy.
+        bad = _nonfinite_local(grads, local).astype(jnp.float32)
+        ok = lax.psum(bad, (DATA_AXIS, PIPE_AXIS, MODEL_AXIS)) == 0.0
+
         if zero1:
             from imagent_tpu.parallel.zero import sgd_momentum_shard_update
             new_params, new_opt_state = sgd_momentum_shard_update(
@@ -409,7 +452,8 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
             updates = jax.tree.map(lambda u: -lr * u, updates)
             new_params = optax.apply_updates(state.params, updates)
 
-        metrics = lax.psum(local, DATA_AXIS)
+        metrics = lax.psum(jnp.where(ok, local, jnp.zeros_like(local)),
+                           DATA_AXIS)
 
         new_ema = state.ema_params
         new_ema_bs = state.ema_batch_stats
@@ -428,6 +472,19 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
                     lambda e, s: ema_decay * e + (1.0 - ema_decay) * s,
                     state.ema_batch_stats, new_bs)
 
+        # Skipped step: every state component keeps its pre-step value
+        # (``step`` still advances — the batch WAS consumed, so the
+        # resume bookkeeping and the per-step augmentation stream stay
+        # aligned with the loader's deterministic order).
+        new_params = _skip_if_bad(ok, new_params, state.params)
+        new_opt_state = _skip_if_bad(ok, new_opt_state, state.opt_state)
+        new_bs = _skip_if_bad(ok, new_bs, state.batch_stats)
+        if ema_decay > 0.0:
+            new_ema = _skip_if_bad(ok, new_ema, state.ema_params)
+            if new_ema_bs is not None:
+                new_ema_bs = _skip_if_bad(ok, new_ema_bs,
+                                          state.ema_batch_stats)
+
         new_state = state.replace(
             step=state.step + 1, params=new_params,
             batch_stats=new_bs, opt_state=new_opt_state,
@@ -435,7 +492,7 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         return new_state, metrics
 
     st = state_specs if state_specs is not None else P()
-    sharded = jax.shard_map(
+    sharded = shard_map(
         per_device_step, mesh=mesh,
         in_specs=(st, P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=(st, P()),
@@ -518,6 +575,11 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
                 images, labels = mix_fn(mkey, images, labels)
         grads, metrics, new_bs = accumulate_auto(
             state.params, state.batch_stats, images, labels)
+        # Non-finite step guard — same semantics as the explicit path;
+        # the partitioner sees logical arrays, so no psum is needed for
+        # the verdict to be globally agreed.
+        ok = jnp.logical_not(_nonfinite_local(grads, metrics))
+        metrics = jnp.where(ok, metrics, jnp.zeros_like(metrics))
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params)
         new_params = optax.apply_updates(
@@ -538,6 +600,14 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
                 new_ema_bs = jax.tree.map(
                     lambda e, s: ema_decay * e + (1.0 - ema_decay) * s,
                     state.ema_batch_stats, new_bs)
+        new_params = _skip_if_bad(ok, new_params, state.params)
+        new_opt_state = _skip_if_bad(ok, new_opt_state, state.opt_state)
+        new_bs = _skip_if_bad(ok, new_bs, state.batch_stats)
+        if ema_decay > 0.0:
+            new_ema = _skip_if_bad(ok, new_ema, state.ema_params)
+            if new_ema_bs is not None:
+                new_ema_bs = _skip_if_bad(ok, new_ema_bs,
+                                          state.ema_batch_stats)
         return state.replace(step=state.step + 1, params=new_params,
                              batch_stats=new_bs,
                              opt_state=new_opt_state,
@@ -591,7 +661,7 @@ def make_eval_step(model, mesh: Mesh,
                         DATA_AXIS)
 
     st = state_specs if state_specs is not None else P()
-    sharded = jax.shard_map(
+    sharded = shard_map(
         per_device_eval, mesh=mesh,
         in_specs=(st, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(),

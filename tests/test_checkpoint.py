@@ -100,9 +100,14 @@ def test_prior_five_field_meta_layout_restores(tmp_path):
 
     state = replicate_state(_tiny_state(), make_mesh(model_parallel=1))
     path = os.path.abspath(str(tmp_path / "last"))
-    old_meta = {"epoch": np.int64(4), "best_top1": np.float64(39.0),
-                "best_top5": np.float64(70.0), "best_epoch": np.int64(4),
-                "resume_step": np.int64(0)}
+    # 0-d ndarrays, not bare numpy scalars: older Orbax versions reject
+    # np.int64 leaves in save() (the framework's own save() always
+    # wraps with np.asarray).
+    old_meta = {"epoch": np.asarray(4, np.int64),
+                "best_top1": np.asarray(39.0, np.float64),
+                "best_top5": np.asarray(70.0, np.float64),
+                "best_epoch": np.asarray(4, np.int64),
+                "resume_step": np.asarray(0, np.int64)}
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, {"state": state, "meta": old_meta})
     ckptr.wait_until_finished()
@@ -127,9 +132,11 @@ def test_prior_meta_layout_restores_without_metadata_api(
 
     state = replicate_state(_tiny_state(), make_mesh(model_parallel=1))
     path = os.path.abspath(str(tmp_path / "last"))
-    old_meta = {"epoch": np.int64(7), "best_top1": np.float64(55.0),
-                "best_top5": np.float64(80.0), "best_epoch": np.int64(6),
-                "resume_step": np.int64(0)}
+    old_meta = {"epoch": np.asarray(7, np.int64),
+                "best_top1": np.asarray(55.0, np.float64),
+                "best_top5": np.asarray(80.0, np.float64),
+                "best_epoch": np.asarray(6, np.int64),
+                "resume_step": np.asarray(0, np.int64)}
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, {"state": state, "meta": old_meta})
     ckptr.wait_until_finished()

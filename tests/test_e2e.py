@@ -2,7 +2,9 @@
 init→shard→step→psum→metrics→log→checkpoint path on 8 fake devices with
 synthetic data — the BASELINE.json "CPU smoke" config, hardware-free."""
 
+import jax
 import numpy as np
+import pytest
 
 from imagent_tpu.config import Config
 from imagent_tpu.engine import run
@@ -80,6 +82,11 @@ def test_e2e_eval_only(tmp_path):
     assert result["final_train"]["top1"] == 0.0  # nothing trained
 
 
+@pytest.mark.skipif(not hasattr(jax, "shard_map"),
+                    reason="persistent XLA compilation cache segfaults on "
+                           "jax<0.5 CPU when a cached executable is "
+                           "reloaded in-process (reproduced on the seed "
+                           "code; crashes the whole pytest session)")
 def test_e2e_compile_cache_and_async_ckpt(tmp_path):
     """--compile-cache populates the persistent XLA cache; async LAST
     saves land durably (meta written only after finalize) and resume."""

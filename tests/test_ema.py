@@ -145,6 +145,7 @@ def test_ema_toggle_across_restore(tmp_path):
         got2.params, with_ema.params)
 
 
+@pytest.mark.slow  # engine-heavy: keeps tier-1 inside its 870s budget
 def test_engine_enables_ema_mid_run(tmp_path):
     """End-to-end: a run checkpointed without EMA resumes with
     --ema-decay on (and back off) through engine.run."""

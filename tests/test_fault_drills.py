@@ -1,0 +1,169 @@
+"""End-to-end fault drills on the CPU backend: every recovery path the
+resilience subsystem ships is driven by an injected fault
+(resilience/faultinject.py) and must recover WITHOUT human
+intervention — torn-checkpoint fallback restore, NaN-gradient skip +
+rollback, watchdog checkpoint-and-exit, and the in-process SIGTERM
+preemption path. The synthetic dataset geometry (128 imgs / global
+batch 32 on the 8 fake devices) gives exactly 4 steps/epoch, which the
+fault windows below count on."""
+
+import signal
+
+import pytest
+
+import jax
+
+from imagent_tpu import checkpoint as ckpt_lib
+from imagent_tpu.config import Config
+from imagent_tpu.engine import run
+from imagent_tpu.resilience import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faultinject.reset()
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(arch="resnet18", image_size=16, num_classes=4, batch_size=4,
+                epochs=2, lr=0.05, dataset="synthetic", synthetic_size=128,
+                workers=0, bf16=False, log_every=0, seed=0, save_model=True,
+                log_dir=str(tmp_path / "tb"), ckpt_dir=str(tmp_path / "ck"))
+    base.update(kw)
+    return Config(**base)
+
+
+def test_nan_grad_rollback_drill(tmp_path, capsys):
+    """Epoch 0 trains clean and checkpoints; every step of epoch 1 is
+    NaN-poisoned (calls 5-8 of the nan-grads point). The in-graph guard
+    skips each bad update; after --max-bad-steps consecutive skips the
+    engine rolls back to the epoch-0 checkpoint and replays epoch 1 —
+    by then the fault window has passed, so the run completes clean."""
+    result = run(_cfg(tmp_path, faults="nan-grads:after=4;times=4",
+                      max_bad_steps=2))
+    assert result["rollbacks"] == 1
+    assert result["preempted"] is False
+    assert result["best_epoch"] >= 0
+    out = capsys.readouterr().out
+    assert "non-finite step skipped" in out
+    assert "ROLLBACK 1/" in out
+
+
+def test_nan_grads_without_checkpoint_warns_and_continues(tmp_path,
+                                                          capsys):
+    """No checkpoint to roll back to: the in-graph skip means the live
+    state is unpoisoned, so the run must warn and press on (bounded by
+    the rollback budget) rather than kill an intact run because
+    --save-model is off."""
+    result = run(_cfg(tmp_path, save_model=False, epochs=2,
+                      faults="nan-grads:times=5", max_bad_steps=2))
+    assert result["rollbacks"] == 1
+    assert result["preempted"] is False
+    out = capsys.readouterr().out
+    assert "no checkpoint to roll back to" in out
+    assert "abandoning the rest of this epoch" in out
+
+
+def test_persistent_nan_without_checkpoint_gives_up(tmp_path):
+    """...but a fault that trips the guard epoch after epoch still ends
+    the run with diagnosis instead of spinning forever."""
+    with pytest.raises(RuntimeError, match="persisted through"):
+        run(_cfg(tmp_path, save_model=False, epochs=50,
+                 faults="nan-grads:times=1000", max_bad_steps=2))
+
+
+def test_torn_checkpoint_fault_falls_back_to_previous(tmp_path):
+    """Checkpoint-level drill: the torn-checkpoint fault point truncates
+    the SECOND commit mid-write; the fallback chain must land on the
+    previous good LAST (keep-last-k rotation), not fail the restore."""
+    from imagent_tpu.cluster import make_mesh
+    from imagent_tpu.models import create_model
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, replicate_state,
+    )
+
+    mesh = make_mesh(model_parallel=1)
+    state = replicate_state(
+        create_train_state(create_model("resnet18", num_classes=4),
+                           jax.random.key(0), 16, make_optimizer()), mesh)
+    d = str(tmp_path)
+    ckpt_lib.save(d, "last", state, {"epoch": 0}, keep_last_k=2)
+    faultinject.configure("torn-checkpoint")
+    ckpt_lib.save(d, "last", state, {"epoch": 1}, keep_last_k=2)
+    faultinject.reset()
+
+    restored = ckpt_lib.restore_resilient(d, state)
+    assert restored is not None
+    _, meta, src = restored
+    assert src == "last.1" and meta["epoch"] == 0
+
+
+def test_corrupt_resume_falls_back_through_engine(tmp_path, capsys):
+    """Engine-level drill: bit-rot on the live LAST after a clean run;
+    --resume must verify, warn, fall back to the rotated previous LAST,
+    and finish the remaining epochs without intervention."""
+    run(_cfg(tmp_path, epochs=2, keep_last_k=2))
+    # Corrupt the live LAST's largest file (same shape a torn write or
+    # bit-rot leaves; the manifest catches it on restore).
+    root = tmp_path / "ck" / "last"
+    victim = max((p for p in root.rglob("*") if p.is_file()),
+                 key=lambda p: p.stat().st_size)
+    victim.write_bytes(victim.read_bytes()[:victim.stat().st_size // 2])
+
+    result = run(_cfg(tmp_path, epochs=3, resume=True, keep_last_k=2))
+    out = capsys.readouterr().out
+    assert "failed integrity verification" in out
+    assert "fallback checkpoint last.1" in out
+    assert result["preempted"] is False and result["best_epoch"] >= 0
+
+
+def test_watchdog_drill_checkpoint_and_exit(tmp_path, capsys):
+    """A stalled step (hung-collective stand-in) past the watchdog
+    deadline dumps all-thread stacks and rides the preemption path:
+    checkpoint LAST, exit cleanly, resumable."""
+    result = run(_cfg(tmp_path, watchdog_secs=2.0,
+                      faults="stall-step:after=2;secs=6"))
+    assert result["preempted"] is True
+    assert (tmp_path / "ck" / "last").is_dir()
+    captured = capsys.readouterr()
+    assert "WATCHDOG" in captured.err
+    assert "all-thread stack dump" in captured.err
+    assert "preemption signal" in captured.out
+
+    faultinject.reset()  # drop the drill for the requeue
+    resumed = run(_cfg(tmp_path, resume=True))
+    assert resumed["preempted"] is False and resumed["best_epoch"] >= 0
+
+
+def test_sigterm_fault_preempts_cleanly(tmp_path):
+    """The sigterm fault point delivers a real SIGTERM mid-epoch; the
+    chained PreemptionGuard checkpoints and exits cleanly — the Slurm
+    pre-kill path without an external killer."""
+    prior = signal.getsignal(signal.SIGTERM)
+    result = run(_cfg(tmp_path, faults="sigterm:after=2"))
+    assert result["preempted"] is True
+    import json
+    meta = json.loads((tmp_path / "ck" / "last_meta.json").read_text())
+    assert meta["resume_step"] > 0
+    # Guard uninstalled: the pre-run handler is back.
+    assert signal.getsignal(signal.SIGTERM) is prior
+
+    # Disarm before resuming: configure() exports the spec to the env
+    # (for spawned decode workers), so without this the resumed run
+    # re-arms the drill — as a real requeue re-running the same
+    # --faults flags would.
+    faultinject.reset()
+    resumed = run(_cfg(tmp_path, resume=True))
+    assert resumed["preempted"] is False
+
+
+def test_guard_counts_bad_steps_in_epoch_metrics(tmp_path):
+    """A single transient NaN step (below --max-bad-steps) is skipped
+    and surfaced in the epoch metrics, with no rollback."""
+    result = run(_cfg(tmp_path, epochs=1, faults="nan-grads:after=1",
+                      max_bad_steps=3))
+    assert result["rollbacks"] == 0
+    assert result["final_train"]["bad_steps"] == 1
+    # 4 steps/epoch, one skipped: the other 3 still count samples.
+    assert result["final_train"]["n"] == 3 * 32

@@ -2,6 +2,8 @@
 on normalized batches (un-normalize → jitter → re-normalize in-graph).
 """
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -97,6 +99,7 @@ def test_engine_jitter_smoke(tmp_path):
     assert np.isfinite(result["final_train"]["loss"])
 
 
+@pytest.mark.slow  # engine-heavy: keeps tier-1 inside its 870s budget
 def test_full_extended_recipe_composes(tmp_path):
     """Every round-3 lever in ONE run: jitter + mixup/cutmix + EMA +
     label smoothing + cosine/warmup + grad accumulation — the whole

@@ -53,6 +53,7 @@ def _write_stub(path, content):
     os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR)
 
 
+@pytest.mark.slow  # engine-heavy: keeps tier-1 inside its 870s budget
 def test_slurm_launcher_runs_two_rank_training(tmp_path):
     """sbatch-equivalent execution: the launcher script body, a fake
     srun, 2 ranks, REAL cross-process rendezvous + training + ckpt."""

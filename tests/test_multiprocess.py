@@ -11,6 +11,12 @@ import numpy as np
 
 from mp_launch import launch_group, launch_pair, parse_metrics
 
+import pytest
+
+# Spawned multi-process groups each recompile the step: far too heavy
+# for the 870s tier-1 budget (run explicitly or in the full suite).
+pytestmark = pytest.mark.slow
+
 
 def test_two_process_train_step_matches_single():
     outs = launch_pair("mp_worker.py")

@@ -1,0 +1,384 @@
+"""Resilience subsystem unit tests: fault registry, backoff retry,
+watchdog, checkpoint integrity manifests, signal-handler chaining, the
+in-graph non-finite step guard, and the data-path quarantine/retry
+wiring. The end-to-end fault drills live in test_fault_drills.py."""
+
+import io
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from imagent_tpu.resilience import faultinject, integrity
+from imagent_tpu.resilience.retry import backoff_delays, retry_call
+from imagent_tpu.resilience.watchdog import StepWatchdog, dump_all_stacks
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faultinject.reset()
+
+
+# ---------------------------------------------------------------- faults
+
+def test_fault_spec_parsing():
+    faults = faultinject.parse_spec(
+        "nan-grads:after=4;times=4,stall-step:secs=6.5,sigterm")
+    assert faults["nan-grads"].after == 4
+    assert faults["nan-grads"].times == 4
+    assert faults["stall-step"].get("secs") == 6.5
+    assert faults["sigterm"].after == 0 and faults["sigterm"].times == 1
+    with pytest.raises(ValueError):
+        faultinject.parse_spec("name:notakv")
+
+
+def test_fault_fire_windowing():
+    faultinject.configure("boom:after=2;times=2")
+    hits = [faultinject.fire("boom") is not None for _ in range(6)]
+    assert hits == [False, False, True, True, False, False]
+    assert faultinject.fire("unarmed") is None
+
+
+def test_fault_disabled_is_noop():
+    faultinject.reset()
+    assert not faultinject.active()
+    assert faultinject.fire("anything") is None
+
+
+def test_fault_env_pickup(monkeypatch):
+    monkeypatch.setenv(faultinject.ENV_VAR, "envfault:times=3")
+    faultinject.configure(None)
+    assert faultinject.fire("envfault") is not None
+
+
+# ----------------------------------------------------------------- retry
+
+def test_retry_recovers_after_transient_failures():
+    sleeps, calls = [], {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_call(flaky, attempts=3, base_delay=0.01,
+                      sleep=sleeps.append) == "ok"
+    assert calls["n"] == 3 and len(sleeps) == 2
+    assert sleeps[1] > sleeps[0]  # exponential growth (jitter < 2x base)
+
+
+def test_retry_exhausts_and_reraises():
+    def always_bad():
+        raise OSError("permanent")
+
+    with pytest.raises(OSError, match="permanent"):
+        retry_call(always_bad, attempts=3, base_delay=0.001,
+                   sleep=lambda _: None)
+
+
+def test_retry_does_not_catch_unlisted_exceptions():
+    def bad():
+        raise ValueError("not retryable")
+
+    with pytest.raises(ValueError):
+        retry_call(bad, attempts=3, sleep=lambda _: None)
+
+
+def test_backoff_delays_capped_and_jittered():
+    delays = list(backoff_delays(6, base_delay=0.1, max_delay=0.5,
+                                 jitter=0.5))
+    assert len(delays) == 5
+    for base, got in zip([0.1, 0.2, 0.4, 0.5, 0.5], delays):
+        assert base <= got <= base * 1.5 + 1e-9
+
+
+def test_scontrol_fallback_retries(monkeypatch):
+    """The coordinator resolution survives a transiently-failing
+    scontrol (busy slurmctld at job start)."""
+    import subprocess
+
+    from imagent_tpu import cluster
+
+    monkeypatch.setattr(cluster, "expand_nodelist",
+                        lambda nl: (_ for _ in ()).throw(ValueError()))
+    calls = {"n": 0}
+
+    def flaky_run(*a, **k):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise subprocess.CalledProcessError(1, a[0])
+
+        class R:
+            stdout = "node001\nnode002\n"
+        return R()
+
+    monkeypatch.setattr(cluster.subprocess, "run", flaky_run)
+    assert cluster.resolve_coordinator("node[001-002]") == "node001"
+    assert calls["n"] == 3
+
+
+# -------------------------------------------------------------- watchdog
+
+def test_watchdog_fires_on_missed_heartbeat():
+    out = io.StringIO()
+    wd = StepWatchdog(0.2, out=out)
+    try:
+        wd.arm()
+        wd.beat()
+        time.sleep(0.8)
+        assert wd.fired
+        dump = out.getvalue()
+        assert "all-thread stack dump" in dump
+        assert "test_watchdog_fires_on_missed_heartbeat" in dump
+    finally:
+        wd.stop()
+
+
+def test_watchdog_quiet_while_beating_and_before_first_beat():
+    out = io.StringIO()
+    wd = StepWatchdog(0.3, out=out)
+    try:
+        wd.arm()
+        # No beat yet: the countdown must not start (first-step
+        # compilation can take minutes).
+        time.sleep(0.6)
+        assert not wd.fired
+        for _ in range(4):
+            wd.beat()
+            time.sleep(0.1)
+        assert not wd.fired
+        wd.disarm()
+        time.sleep(0.6)
+        assert not wd.fired  # disarmed windows (eval/checkpoint) are free
+    finally:
+        wd.stop()
+
+
+def test_dump_all_stacks_names_threads():
+    out = io.StringIO()
+    dump_all_stacks(out)
+    assert "MainThread" in out.getvalue()
+
+
+# ------------------------------------------------------------- integrity
+
+def test_manifest_roundtrip_and_corruption_detection(tmp_path):
+    root = tmp_path / "ckpt"
+    (root / "sub").mkdir(parents=True)
+    (root / "a.bin").write_bytes(b"x" * 1000)
+    (root / "sub" / "b.bin").write_bytes(b"y" * 500)
+    integrity.write_manifest(str(tmp_path), "ckpt")
+    ok, detail = integrity.verify(str(tmp_path), "ckpt")
+    assert ok and "verified 2" in detail
+
+    # Truncation (torn write) — size mismatch.
+    (root / "a.bin").write_bytes(b"x" * 400)
+    ok, detail = integrity.verify(str(tmp_path), "ckpt")
+    assert not ok and "size mismatch" in detail
+
+    # Same-size bit-rot — checksum mismatch.
+    (root / "a.bin").write_bytes(b"z" * 1000)
+    ok, detail = integrity.verify(str(tmp_path), "ckpt")
+    assert not ok and "checksum mismatch" in detail
+
+    (root / "a.bin").write_bytes(b"x" * 1000)
+    ok, _ = integrity.verify(str(tmp_path), "ckpt")
+    assert ok
+
+    # A file vanishing or appearing is also a failed verification.
+    (root / "sub" / "b.bin").unlink()
+    ok, detail = integrity.verify(str(tmp_path), "ckpt")
+    assert not ok and "missing file" in detail
+    (root / "sub" / "b.bin").write_bytes(b"y" * 500)
+    (root / "extra.bin").write_bytes(b"?")
+    ok, detail = integrity.verify(str(tmp_path), "ckpt")
+    assert not ok and "unexpected" in detail
+
+
+def test_missing_manifest_is_unverified_but_accepted(tmp_path):
+    (tmp_path / "old").mkdir()
+    (tmp_path / "old" / "data").write_bytes(b"legacy")
+    ok, detail = integrity.verify(str(tmp_path), "old")
+    assert ok and "unverified" in detail
+
+
+def test_fallback_candidates_order(tmp_path):
+    from imagent_tpu import checkpoint as ckpt_lib
+
+    for name in ("last", "last.1", "last.2", "best"):
+        (tmp_path / name).mkdir()
+    assert ckpt_lib.fallback_candidates(str(tmp_path), "last") == [
+        "last", "last.1", "last.2", "last.old", "best"]
+
+
+# ------------------------------------------------- PreemptionGuard chain
+
+def test_preemption_guard_chains_and_restores_handlers():
+    from imagent_tpu.engine import PreemptionGuard
+
+    chained = {"n": 0}
+
+    def prior_handler(signum, frame):
+        chained["n"] += 1
+
+    old = signal.signal(signal.SIGUSR1, prior_handler)
+    try:
+        guard = PreemptionGuard()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        # Synchronous delivery on the main thread (single-threaded kill).
+        assert guard.requested
+        assert chained["n"] == 1  # prior handler still ran
+        guard.uninstall()
+        assert signal.getsignal(signal.SIGUSR1) is prior_handler
+    finally:
+        signal.signal(signal.SIGUSR1, old)
+
+
+def test_preemption_guard_request():
+    from imagent_tpu.engine import PreemptionGuard
+
+    guard = PreemptionGuard()
+    try:
+        assert not guard()
+        guard.request()
+        assert guard()
+    finally:
+        guard.uninstall()
+
+
+# ------------------------------------------- non-finite step guard (jit)
+
+def test_nonfinite_step_skipped_in_graph(mesh8):
+    """A NaN batch must leave params/opt-state/BN untouched, zero the
+    metric vector (the n == 0 bad-step flag), and still advance the
+    step counter — with the vector keeping its (4,) contract."""
+    from imagent_tpu.models import create_model
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step,
+        replicate_state, shard_batch,
+    )
+
+    model = create_model("resnet18", num_classes=4)
+    opt = make_optimizer()
+    state = replicate_state(
+        create_train_state(model, jax.random.key(0), 16, opt), mesh8)
+    step = make_train_step(model, opt, mesh8)
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(16, 16, 16, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(16,)).astype(np.int32)
+
+    gi, gl = shard_batch(mesh8, images, labels)
+    state, m = step(state, gi, gl, np.float32(0.1))
+    assert np.asarray(m).shape == (4,) and np.asarray(m)[3] == 16
+
+    before = jax.device_get(state)
+    gi, gl = shard_batch(mesh8, np.full_like(images, np.nan), labels)
+    state, m = step(state, gi, gl, np.float32(0.1))
+    m = np.asarray(m)
+    assert m.shape == (4,) and (m == 0).all()
+    after = jax.device_get(state)
+    for b, a in zip(jax.tree_util.tree_leaves(before.params),
+                    jax.tree_util.tree_leaves(after.params)):
+        np.testing.assert_array_equal(b, a)
+    for b, a in zip(jax.tree_util.tree_leaves(before.opt_state),
+                    jax.tree_util.tree_leaves(after.opt_state)):
+        np.testing.assert_array_equal(b, a)
+    for b, a in zip(jax.tree_util.tree_leaves(before.batch_stats),
+                    jax.tree_util.tree_leaves(after.batch_stats)):
+        np.testing.assert_array_equal(b, a)
+    assert int(after.step) == int(before.step) + 1
+
+    # Recovery: the next finite batch trains normally.
+    gi, gl = shard_batch(mesh8, images, labels)
+    _, m = step(state, gi, gl, np.float32(0.1))
+    assert np.asarray(m)[3] == 16
+
+
+# --------------------------------------------- decode retry / quarantine
+
+def _write_png(path, rng):
+    from PIL import Image
+
+    arr = rng.integers(0, 255, size=(24, 24, 3)).astype(np.uint8)
+    Image.fromarray(arr).save(path)
+
+
+def test_decode_retry_rescues_transient_fault(tmp_path):
+    from imagent_tpu.data.imagefolder import (
+        _decode_one_robust, _init_worker,
+    )
+
+    rng = np.random.default_rng(0)
+    p = str(tmp_path / "img.png")
+    _write_png(p, rng)
+    _init_worker(16, (0.5, 0.5, 0.5), (0.5, 0.5, 0.5))
+
+    # One injected failure: the retry's second attempt succeeds.
+    faultinject.configure("corrupt-image:times=1")
+    img, ok = _decode_one_robust(p)
+    assert ok and img.shape == (16, 16, 3)
+
+    # Failure outlasting the retry budget: quarantined as zeros.
+    faultinject.configure("corrupt-image:times=10")
+    img, ok = _decode_one_robust(p)
+    assert not ok and (img == 0).all()
+
+
+def test_corrupt_image_fault_reaches_spawned_pool_workers(tmp_path,
+                                                          capsys):
+    """The fault registry is per-process; configure() exports the spec
+    to IMAGENT_FAULTS so the spawn-context decode pool (fresh
+    interpreters) arms it too — otherwise a --faults corrupt-image
+    drill on the PIL pool path injects nothing where the decoding
+    actually happens."""
+    from imagent_tpu.config import Config
+    from imagent_tpu.data.imagefolder import ImageFolderLoader
+
+    rng = np.random.default_rng(3)
+    cls = tmp_path / "train" / "class_a"
+    cls.mkdir(parents=True)
+    for i in range(4):
+        _write_png(str(cls / f"ok{i}.png"), rng)
+
+    faultinject.configure("corrupt-image:times=1000")
+    assert os.environ.get(faultinject.ENV_VAR)  # exported for spawn
+    cfg = Config(data_root=str(tmp_path), image_size=16, batch_size=4,
+                 workers=2, native_io=False, augment=False)
+    loader = ImageFolderLoader(cfg, 0, 1, 4, "train")
+    try:
+        batches = list(loader.epoch(0))
+        assert len(batches) == 1
+        # Every decode attempt failed inside the workers: all zeros.
+        assert (batches[0].images == 0).all()
+        assert "4 unreadable" in capsys.readouterr().out
+    finally:
+        loader.close()
+
+
+def test_loader_quarantines_unreadable_file(tmp_path, capsys):
+    """A garbage image file costs a zero-filled sample and a per-epoch
+    quarantine WARNING — never the run."""
+    from imagent_tpu.config import Config
+    from imagent_tpu.data.imagefolder import ImageFolderLoader
+
+    rng = np.random.default_rng(1)
+    cls = tmp_path / "train" / "class_a"
+    cls.mkdir(parents=True)
+    for i in range(7):
+        _write_png(str(cls / f"ok{i}.png"), rng)
+    (cls / "bad.png").write_bytes(b"this is not an image at all")
+
+    cfg = Config(data_root=str(tmp_path), image_size=16, batch_size=8,
+                 workers=0, native_io=False, augment=False)
+    loader = ImageFolderLoader(cfg, 0, 1, 8, "train")
+    batches = list(loader.epoch(0))
+    assert len(batches) == 1 and batches[0].images.shape[0] == 8
+    out = capsys.readouterr().out
+    assert "quarantined" in out and "1 unreadable" in out
+    loader.close()

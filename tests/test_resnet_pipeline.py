@@ -22,6 +22,7 @@ from imagent_tpu.train import (
     create_train_state, make_eval_step, make_optimizer, make_train_step,
     place_state, replicate_state, shard_batch, state_partition_specs,
 )
+from imagent_tpu.compat.jaxcompat import shard_map
 
 CLASSES, SIZE, M = 8, 32, 2
 BATCH = 32  # global; dp = 8/(pp=2) = 4 -> per-device 8, micro-batch 4
@@ -103,7 +104,7 @@ def test_pipelined_eval_grads_exact():
         g = jax.tree.map(lambda a: lax.pmean(a, DATA_AXIS), g)
         return normalize_region_grads(g, specs_p, PIPE_AXIS)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         per_device, mesh=mesh, in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(), check_vma=False))
     gi, gl = shard_batch(mesh, images, labels)
@@ -183,6 +184,7 @@ def test_microbatch_divisibility_validated():
         pp.apply(v, jnp.zeros((8, SIZE, SIZE, 3)), train=False)
 
 
+@pytest.mark.slow  # engine-heavy: keeps tier-1 inside its 870s budget
 def test_resnet_pp_e2e_from_cli(tmp_path):
     """The operator surface: --arch resnet18 --pipeline-parallel 2 runs
     end-to-end through engine.run (train + masked eval + checkpoint)."""

@@ -14,6 +14,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(_DIR)
 
@@ -63,6 +65,7 @@ def test_resume_on_more_devices(tmp_path):
     assert "Epoch 2:" in second.stdout
 
 
+@pytest.mark.slow  # engine-heavy: keeps tier-1 inside its 870s budget
 def test_zero1_resume_across_data_axis_sizes(tmp_path):
     """ZeRO-1's flat momentum buffer is padded to a multiple of dp;
     resuming on a different data-axis size must repartition it (restore

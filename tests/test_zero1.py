@@ -3,6 +3,8 @@
 replicated optax path — same torch-SGD order — while actually
 partitioning the buffer across devices."""
 
+import pytest
+
 import jax
 import numpy as np
 
@@ -13,6 +15,7 @@ from imagent_tpu.train import (
     create_train_state, make_optimizer, make_train_step, place_state,
     replicate_state, shard_batch,
 )
+from imagent_tpu.compat.jaxcompat import shard_map
 
 SIZE = 16
 BATCH = 16
@@ -62,7 +65,7 @@ def test_zero1_update_bitwise_matches_optax():
     def one_step(p, g, o):
         return zero_lib.sgd_momentum_shard_update(p, g, o, lr, mu, wd)
 
-    stepped = jax.jit(jax.shard_map(
+    stepped = jax.jit(shard_map(
         one_step, mesh=mesh,
         in_specs=(P(), P(), P(DATA_AXIS)), out_specs=(P(), P(DATA_AXIS)),
         check_vma=False))
@@ -77,6 +80,7 @@ def test_zero1_update_bitwise_matches_optax():
             np.asarray(b), np.asarray(a), nulp=8)
 
 
+@pytest.mark.slow  # engine-heavy: keeps tier-1 inside its 870s budget
 def test_zero1_resnet_integration_close():
     """Full-model integration, ONE step: step-1 metrics are computed from
     identical initial params so they match exactly; updated params match
@@ -137,6 +141,7 @@ def test_zero1_buffer_actually_sharded():
     assert shard_shapes == {(z_state.opt_state.shape[0] // 8,)}
 
 
+@pytest.mark.slow  # engine-heavy: keeps tier-1 inside its 870s budget
 def test_zero1_e2e_smoke(tmp_path):
     """Engine-level: --zero1 trains, checkpoints, and resumes."""
     from imagent_tpu.config import Config
