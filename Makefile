@@ -4,7 +4,7 @@
 PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
 
-.PHONY: smoke test
+.PHONY: smoke test bench-smoke
 
 # Fast confidence tier (<5 min on CPU): the resilience unit tests, the
 # end-to-end fault-injection drills (torn checkpoint, NaN rollback,
@@ -16,3 +16,9 @@ smoke:
 # The full tier-1 gate (what CI runs).
 test:
 	$(PYTEST) -m "not slow" --continue-on-collection-errors tests/
+
+# Tiny synthetic-data bench iteration through the real input path
+# (uint8 wire -> device_prefetch -> in-graph normalize -> step) on the
+# CPU backend: catches input-path crashes before a real bench run.
+bench-smoke:
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/bench_smoke.py

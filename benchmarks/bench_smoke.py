@@ -1,0 +1,97 @@
+"""CPU-backend input-path smoke bench (``make bench-smoke``).
+
+A tiny synthetic-data bench iteration through the REAL input path —
+SyntheticLoader (uint8 wire, ``data/pipeline.py`` Batch contract) →
+``device_prefetch`` staging (with the starvation counters) → the jitted
+train step with in-graph dequantize+normalize → one masked eval batch —
+on the CPU backend, no TPU required. CI runs this so an input-path
+crash (wire-dtype regression, Batch contract break, prefetch deadlock)
+surfaces here, in under a minute, instead of burning a real bench run.
+
+Prints one JSON line (throughput is incidental — a CPU number on a
+tiny model; the PASS signal is the point) and exits non-zero on any
+crash or a non-finite loss.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    from imagent_tpu.cluster import make_mesh
+    from imagent_tpu.config import Config
+    from imagent_tpu.data import make_loaders
+    from imagent_tpu.data.prefetch import PrefetchStats, device_prefetch
+    from imagent_tpu.train import (
+        create_train_state, make_eval_step, make_optimizer,
+        make_train_step, replicate_state,
+    )
+
+    n_chips = len(jax.devices())
+    cfg = Config(arch="resnet18", image_size=16, num_classes=4,
+                 batch_size=4, dataset="synthetic", synthetic_size=32,
+                 workers=0, bf16=False, seed=0)
+    global_batch = cfg.batch_size * n_chips
+    mesh = make_mesh(model_parallel=1)
+    from imagent_tpu.models import create_model
+    model = create_model(cfg.arch, cfg.num_classes, bf16=False)
+    opt = make_optimizer()
+    state = replicate_state(
+        create_train_state(model, jax.random.key(0), cfg.image_size, opt,
+                           batch_size=2), mesh)
+    step = make_train_step(model, opt, mesh, mean=cfg.mean, std=cfg.std)
+    eval_step = make_eval_step(model, mesh, mean=cfg.mean, std=cfg.std)
+    train_loader, val_loader = make_loaders(
+        cfg, jax.process_index(), jax.process_count(), global_batch)
+
+    stats = PrefetchStats()
+    t0 = time.time()
+    n_steps = 0
+    wire_dtype = None
+    for batch in train_loader.epoch(0):
+        wire_dtype = str(batch.images.dtype)
+        break
+    for gi, gl in device_prefetch(mesh, train_loader.epoch(0),
+                                  depth=cfg.prefetch_depth, stats=stats):
+        state, metrics = step(state, gi, gl, np.float32(0.1))
+        n_steps += 1
+    m = np.asarray(metrics)
+    train_s = time.time() - t0
+    if not np.isfinite(m).all() or m[3] != global_batch:
+        print(f"FAIL: bad train metrics {m}", file=sys.stderr)
+        return 1
+
+    for gi, gl, gm in device_prefetch(mesh, val_loader.epoch(0),
+                                      with_mask=True):
+        em = np.asarray(eval_step(state, gi, gl, gm))
+        if not np.isfinite(em).all():
+            print(f"FAIL: bad eval metrics {em}", file=sys.stderr)
+            return 1
+        break
+
+    print(json.dumps({
+        "metric": "bench_smoke_input_path",
+        "status": "PASS",
+        "wire_dtype": wire_dtype,
+        "steps": n_steps,
+        "img_s": round(n_steps * global_batch / train_s, 1),
+        "host_blocked_s": round(stats.wait_s, 3),
+        "h2d_bytes": int(stats.bytes_staged),
+        "backend": jax.devices()[0].platform,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,8 +72,8 @@ def main() -> int:
     cfg = Config(arch=a.arch, image_size=a.image_size,
                  num_classes=a.classes, batch_size=a.batch_size,
                  dataset="imagefolder", data_root=a.data_root,
-                 augment=True, workers=workers, bf16=True, input_bf16=True,
-                 log_every=0, seed=0, epochs=2)
+                 augment=True, workers=workers, bf16=True,
+                 log_every=0, seed=0, epochs=2)  # uint8 wire (default)
     global_batch = cfg.batch_size * n_chips
     mesh = make_mesh(model_parallel=1)
     from imagent_tpu.models import create_model
@@ -82,7 +82,7 @@ def main() -> int:
     state = replicate_state(
         create_train_state(model, jax.random.key(0), cfg.image_size, opt,
                            batch_size=2), mesh)
-    step = make_train_step(model, opt, mesh)
+    step = make_train_step(model, opt, mesh, mean=cfg.mean, std=cfg.std)
     train_loader, _ = make_loaders(cfg, jax.process_index(),
                                    jax.process_count(), global_batch)
 
@@ -112,13 +112,11 @@ def main() -> int:
     paths = sorted(glob.glob(os.path.join(
         a.data_root, "train", "*", "*.jpg")))[:local]
     t0 = time.time()
-    imgs, _ = native.decode_resize_batch(
-        paths, cfg.image_size, cfg.mean, cfg.std, n_threads=workers,
+    imgs, _ = native.decode_batch_uint8(
+        paths, cfg.image_size, n_threads=workers,
         aug_seeds=np.arange(local, dtype=np.uint64))
     decode_img_s = local / (time.time() - t0) / n_chips
-    import ml_dtypes
-    host_batch = np.tile(imgs.astype(ml_dtypes.bfloat16),
-                         (n_chips, 1, 1, 1))  # one GLOBAL batch
+    host_batch = np.tile(imgs, (n_chips, 1, 1, 1))  # one GLOBAL uint8 batch
     labels = np.zeros((global_batch,), np.int32)
     def _sync(gi, gl):
         # Hard fetch of a reduction over BOTH arrays: np.asarray is the

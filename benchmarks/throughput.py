@@ -8,9 +8,11 @@
         --batch-size 256 --optimizer adamw
 
 Thin CLI over ``bench.measure`` — one measurement harness (jitted SPMD
-train step, device-resident bf16 synthetic batches, paired-window
-differencing with a median estimator, analytic-FLOPs MFU) shared with
-the driver benchmark, so methodology can't drift between the two.
+train step with the production input stage: device-resident uint8 wire
+batches, dequantize+normalize in-graph; paired-window differencing with
+a median estimator, analytic-FLOPs MFU) shared with the driver
+benchmark, so methodology can't drift between the two. ``--no-bf16``
+switches the COMPUTE dtype only — the wire stays uint8 either way.
 Prints one JSON line per run including ``tflops_per_chip`` /
 ``mfu_pct``.
 """

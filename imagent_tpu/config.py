@@ -67,11 +67,19 @@ class Config:
     dataset: str = "imagefolder"  # imagefolder | tar | synthetic
     synthetic_size: int = 2048  # images per epoch in synthetic mode
     bf16: bool = True  # bfloat16 compute on the MXU
-    # Emit bf16 image batches from the input pipeline: halves the
-    # host->device transfer and the step's input HBM read (~+4% step
-    # throughput measured); the model computes in bf16 anyway when
-    # --bf16 is on. Default off = reference parity (fp32 inputs).
-    input_bf16: bool = False
+    # Wire dtype of image batches, decode → IPC → prefetch queue → H2D
+    # (data/pipeline.py Batch contract). All three carry the RAW
+    # [0, 255] pixel scale — dequantize+normalize run in-graph — so
+    # this knob changes bytes on the wire and nothing else:
+    #   uint8   (default) 1 byte/pixel, 4× leaner than the reference's
+    #           host-normalized float32 path (imagenet.py:280-283);
+    #   bf16    2 bytes/pixel (the old --input-bf16 behavior's slot);
+    #   float32 4 bytes/pixel, the A/B parity reference.
+    transfer_dtype: str = "uint8"
+    # Device prefetch staging depth (data/prefetch.py): how many global
+    # batches are staged on-device ahead of the running step. 2 = double
+    # buffering; deeper only adds HBM pressure unless H2D is bursty.
+    prefetch_depth: int = 2
     warmup_epochs: int = 0  # linear LR warmup (0 = reference behavior)
     label_smoothing: float = 0.0  # CE smoothing (0 = reference behavior)
     # In-graph batch augmentation (ops/mixing.py): Beta(a, a) mixing
@@ -244,8 +252,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic-size", type=int, default=c.synthetic_size)
     p.add_argument("--no-bf16", dest="bf16", action="store_false",
                    default=True)
-    p.add_argument("--input-bf16", action="store_true", default=False,
-                   help="input pipeline emits bf16 batches (halves H2D)")
+    p.add_argument("--transfer-dtype", type=str, default=c.transfer_dtype,
+                   choices=["uint8", "bf16", "float32"],
+                   help="image wire dtype host->device; all carry raw "
+                        "[0,255] values, normalization is in-graph "
+                        "(uint8 = 4x leaner than float32)")
+    p.add_argument("--input-bf16", dest="transfer_dtype",
+                   action="store_const", const="bf16",
+                   default=argparse.SUPPRESS,
+                   help="deprecated alias for --transfer-dtype bf16")
+    p.add_argument("--prefetch-depth", type=int, default=c.prefetch_depth,
+                   help="device prefetch staging depth (default 2 = "
+                        "double buffering)")
     p.add_argument("--warmup-epochs", type=int, default=c.warmup_epochs)
     p.add_argument("--label-smoothing", type=float,
                    default=c.label_smoothing)

@@ -7,8 +7,16 @@ NEXT batch's global device arrays (``shard_batch`` →
 ``make_array_from_process_local_data``) while the devices execute the
 current step — so the step dispatch never waits on the transfer.
 
-Depth 2 (double buffering) suffices: deeper queues only add device
-memory pressure (each in-flight batch holds its HBM buffers alive).
+Depth 2 (double buffering, ``--prefetch-depth``) suffices on a steady
+pipeline: deeper queues only add device memory pressure (each in-flight
+batch holds its HBM buffers alive) — raise it when decode latency is
+bursty (cold page cache, networked storage) and the starvation counters
+below show host-blocked time with idle average decode.
+
+``PrefetchStats`` makes input-boundness diagnosable without a profiler
+trace: the consumer's time blocked on the staging queue (the step loop
+starving) and the bytes staged host→device per epoch, both logged by
+the engine's epoch summaries and TensorBoard scalars.
 
 ``iter_with_producer`` is the one shared producer/consumer protocol —
 also used by the host-batch stage (``data/imagefolder.py``) — including
@@ -21,12 +29,36 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterator
 
 from imagent_tpu.train import shard_batch
 
 
-def iter_with_producer(produce: Callable, maxsize: int) -> Iterator:
+class PrefetchStats:
+    """Per-epoch input-starvation counters (reset each epoch).
+
+    ``wait_s``: consumer time blocked in the staging queue's ``get`` —
+    host-blocked time the step loop spent starving for input. ``~0``
+    means compute-bound; approaching the epoch walltime means the
+    decode/H2D pipeline is the bottleneck. ``bytes_staged``: host bytes
+    handed to ``shard_batch`` for the host→device transfer (the wire
+    bytes the ``--transfer-dtype`` knob shrinks). ``batches``: staged
+    batch count."""
+
+    __slots__ = ("wait_s", "bytes_staged", "batches")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.wait_s = 0.0
+        self.bytes_staged = 0
+        self.batches = 0
+
+
+def iter_with_producer(produce: Callable, maxsize: int,
+                       stats: PrefetchStats | None = None) -> Iterator:
     """Yield items that ``produce(put)`` stages from a daemon thread.
 
     ``produce`` receives a ``put(item) -> bool`` callback and should
@@ -36,6 +68,9 @@ def iter_with_producer(produce: Callable, maxsize: int) -> Iterator:
     releases the producer (stop flag + drain) and joins the thread, so
     an interrupted epoch cannot leak the thread or the up-to-``maxsize``
     staged items it holds alive.
+
+    ``stats``: accumulate the consumer's queue-get blocked time into
+    ``stats.wait_s`` (data-starvation observability).
     """
     q: queue.Queue = queue.Queue(maxsize=maxsize)
     stop = threading.Event()
@@ -63,7 +98,12 @@ def iter_with_producer(produce: Callable, maxsize: int) -> Iterator:
     t.start()
     try:
         while True:
-            item = q.get()
+            if stats is None:
+                item = q.get()
+            else:
+                t0 = time.perf_counter()
+                item = q.get()
+                stats.wait_s += time.perf_counter() - t0
             if item is _END:
                 break
             if isinstance(item, BaseException):
@@ -80,16 +120,24 @@ def iter_with_producer(produce: Callable, maxsize: int) -> Iterator:
 
 
 def device_prefetch(mesh, batch_iter, with_mask: bool = False,
-                    depth: int = 2) -> Iterator[tuple]:
-    """Yield tuples of global device arrays, staged ``depth`` ahead.
+                    depth: int = 2,
+                    stats: PrefetchStats | None = None) -> Iterator[tuple]:
+    """Yield tuples of global device arrays, staged ``depth`` ahead
+    (``--prefetch-depth``).
 
     ``batch_iter`` yields ``data.pipeline.Batch``; yields
     ``(images, labels)`` for the train step, or with ``with_mask``
-    ``(images, labels, mask)`` for the eval step.
+    ``(images, labels, mask)`` for the eval step. ``stats`` accumulates
+    host-blocked time and staged host→device bytes for the epoch.
     """
 
     def produce(put):
         for batch in batch_iter:
+            if stats is not None:
+                stats.bytes_staged += (
+                    batch.images.nbytes + batch.labels.nbytes
+                    + (batch.mask.nbytes if with_mask else 0))
+                stats.batches += 1
             if with_mask:
                 item = shard_batch(mesh, batch.images, batch.labels,
                                    batch.mask)
@@ -99,7 +147,7 @@ def device_prefetch(mesh, batch_iter, with_mask: bool = False,
                 return
 
     try:
-        yield from iter_with_producer(produce, depth)
+        yield from iter_with_producer(produce, depth, stats)
     finally:
         # Close the source iterator so its own resources (decode pools,
         # producer threads) unwind deterministically too.

@@ -15,8 +15,18 @@ import numpy as np
 
 from imagent_tpu.config import Config
 from imagent_tpu.data.pipeline import (
-    PAD_ROW, Batch, iter_batch_rows, pad_batch, shard_indices,
+    PAD_ROW, Batch, iter_batch_rows, pad_batch, shard_indices, to_wire,
 )
+
+
+def _quantize_u8(img: np.ndarray) -> np.ndarray:
+    """Float pattern (≈[-1.3, 1.3], zero-centered) → raw uint8 pixels on
+    the wire contract's [0, 255] scale. The affine map targets [0, 1]
+    so the in-graph (x/255 - 0.5)/0.5 normalization lands the model
+    input back near the pattern's native zero-centered range; the clip
+    costs only the noise tails, so the class signal survives."""
+    return np.clip(np.rint((img * 0.5 + 0.5) * 255.0), 0, 255
+                   ).astype(np.uint8)
 
 
 class SyntheticLoader:
@@ -66,12 +76,10 @@ class SyntheticLoader:
             # patterns, different samples → a real generalization split).
             off = 0 if self.train else 10_000_019
             images = np.stack([
-                self._image_for(
+                _quantize_u8(self._image_for(
                     int(l),
-                    np.random.default_rng(cfg.seed * 1000003 + int(r) + off))
+                    np.random.default_rng(cfg.seed * 1000003 + int(r) + off)))
                 for l, r in zip(labels, valid)]) if len(valid) else np.zeros(
-                    (0, cfg.image_size, cfg.image_size, 3), np.float32)
-            if cfg.input_bf16:
-                import ml_dtypes
-                images = images.astype(ml_dtypes.bfloat16)
-            yield pad_batch(images, labels, self.local_rows)
+                    (0, cfg.image_size, cfg.image_size, 3), np.uint8)
+            yield pad_batch(to_wire(images, cfg.transfer_dtype),
+                            labels, self.local_rows)

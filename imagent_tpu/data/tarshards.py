@@ -170,7 +170,7 @@ class TarShardLoader(ImageFolderLoader):
         return [staged[int(r)] for r in rows]
 
     def _decode_batch(self, rows, epoch):
-        from imagent_tpu.data.pipeline import PAD_ROW, pad_batch
+        from imagent_tpu.data.pipeline import PAD_ROW, pad_batch, to_wire
 
         valid = rows[rows != PAD_ROW]
         staged = self._stage_rows(valid)
@@ -193,10 +193,8 @@ class TarShardLoader(ImageFolderLoader):
                 except OSError:
                     pass
         labels = self.labels[valid].astype(np.int32)
-        if self.cfg.input_bf16:
-            import ml_dtypes
-            images = images.astype(ml_dtypes.bfloat16)
-        return pad_batch(images, labels, self.local_rows)
+        return pad_batch(to_wire(images, self.cfg.transfer_dtype),
+                         labels, self.local_rows)
 
     def close(self):
         super().close()

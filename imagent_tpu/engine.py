@@ -34,7 +34,8 @@ from imagent_tpu import checkpoint as ckpt_lib
 from imagent_tpu import cluster
 from imagent_tpu.config import Config
 from imagent_tpu.data import make_loaders
-from imagent_tpu.data.prefetch import device_prefetch
+from imagent_tpu.data.pipeline import WIRE_DTYPES
+from imagent_tpu.data.prefetch import PrefetchStats, device_prefetch
 from imagent_tpu.models import create_model
 from imagent_tpu.resilience import faultinject
 from imagent_tpu.resilience.watchdog import StepWatchdog
@@ -188,6 +189,7 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
     """
     t0 = time.time()
     data_time = AverageMeter("data")
+    stats = PrefetchStats()
     metric_buf = []
     lr_arr = np.float32(lr)
     interrupted_at = -1
@@ -225,9 +227,10 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
         watchdog.arm()
     try:
         t_fetch = time.time()
-        # Batches arrive as device arrays staged one step ahead (H2D
-        # overlapped with the running step, data/prefetch.py).
-        for i, arrays in enumerate(device_prefetch(mesh, it)):
+        # Batches arrive as device arrays staged ahead (H2D overlapped
+        # with the running step, data/prefetch.py; --prefetch-depth).
+        for i, arrays in enumerate(device_prefetch(
+                mesh, it, depth=cfg.prefetch_depth, stats=stats)):
             step_i = start_step + i
             if _stop_agreed(stop_check, step_i):
                 interrupted_at = steps_done
@@ -240,7 +243,10 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                     time.sleep(float(f.get("secs", 5.0)))
                 if faultinject.fire("nan-grads") is not None:
                     # Poison the batch: loss and every gradient go NaN,
-                    # driving the in-graph skip + rollback path.
+                    # driving the in-graph skip + rollback path. The
+                    # multiply promotes a uint8 wire batch to f32 (NaN
+                    # has no uint8 encoding); the step retraces once
+                    # for the f32 input and dequantizes it identically.
                     images = images * jnp.float32(np.nan)
                 if faultinject.fire("sigterm") is not None:
                     os.kill(os.getpid(), signal.SIGTERM)
@@ -269,6 +275,12 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
         if watchdog is not None:
             watchdog.disarm()
     epoch_metrics = _finalize(metric_buf)  # the only mandatory sync point
+    # Data-starvation counters (data/prefetch.py::PrefetchStats): how
+    # long the step loop sat blocked on the staging queue, and the wire
+    # bytes that crossed host→device — input-boundness diagnosable from
+    # the epoch summary alone, no profiler trace needed.
+    epoch_metrics["host_blocked_s"] = round(stats.wait_s, 3)
+    epoch_metrics["h2d_bytes"] = int(stats.bytes_staged)
     return state, epoch_metrics, time.time() - t0, interrupted_at, rollback
 
 
@@ -287,11 +299,16 @@ def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
         if state.ema_batch_stats is not None:
             state = state.replace(batch_stats=state.ema_batch_stats)
     t0 = time.time()
+    stats = PrefetchStats()
     metric_buf = []
     for images, labels, mask in device_prefetch(
-            mesh, loader.epoch(epoch), with_mask=True):
+            mesh, loader.epoch(epoch), with_mask=True,
+            depth=cfg.prefetch_depth, stats=stats):
         metric_buf.append(eval_step(state, images, labels, mask))
-    return _finalize(metric_buf), time.time() - t0
+    metrics = _finalize(metric_buf)
+    metrics["host_blocked_s"] = round(stats.wait_s, 3)
+    metrics["h2d_bytes"] = int(stats.bytes_staged)
+    return metrics, time.time() - t0
 
 
 def _load_torch_weights(cfg: Config, state: TrainState) -> TrainState:
@@ -445,6 +462,12 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
         raise ValueError(
             "--color-jitter takes three non-negative strengths "
             f"(brightness contrast saturation), got {cfg.color_jitter}")
+    if cfg.transfer_dtype not in WIRE_DTYPES:
+        raise ValueError(
+            f"--transfer-dtype must be one of {'|'.join(WIRE_DTYPES)}, "
+            f"got {cfg.transfer_dtype!r}")
+    if cfg.prefetch_depth < 1:
+        raise ValueError("--prefetch-depth must be >= 1")
     use_sp = cfg.seq_parallel != "none"
     if use_sp and (not cfg.arch.startswith("vit") or cfg.model_parallel < 2):
         raise ValueError(
@@ -666,8 +689,7 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
     from imagent_tpu.ops import make_mix_fn
     from imagent_tpu.ops.jitter import make_jitter_fn
     mix_fn = make_mix_fn(cfg.mixup, cfg.cutmix)
-    jitter_fn = make_jitter_fn(*cfg.color_jitter, mean=cfg.mean,
-                               std=cfg.std)
+    jitter_fn = make_jitter_fn(*cfg.color_jitter)
     if cfg.fsdp:
         from imagent_tpu.train import (
             make_eval_step_auto, make_train_step_auto,
@@ -678,8 +700,9 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
             aux_loss_weight=cfg.moe_aux_weight,
             grad_accum=cfg.grad_accum,
             mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay,
-            jitter_fn=jitter_fn)
-        eval_step = make_eval_step_auto(model, mesh, state_specs)
+            jitter_fn=jitter_fn, mean=cfg.mean, std=cfg.std)
+        eval_step = make_eval_step_auto(model, mesh, state_specs,
+                                        mean=cfg.mean, std=cfg.std)
     else:
         train_step = make_train_step(
             model, optimizer, mesh, seq_parallel=use_sp,
@@ -690,8 +713,9 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
             zero1=cfg.zero1, momentum=cfg.momentum,
             weight_decay=cfg.weight_decay,
             mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay,
-            jitter_fn=jitter_fn)
-        eval_step = make_eval_step(model, mesh, state_specs)
+            jitter_fn=jitter_fn, mean=cfg.mean, std=cfg.std)
+        eval_step = make_eval_step(model, mesh, state_specs,
+                                   mean=cfg.mean, std=cfg.std)
 
     def _resume_point(meta: dict) -> tuple[int, int, float, float, int]:
         """(start_epoch, resume_step, best_top1, best_top5, best_epoch)

@@ -184,6 +184,37 @@ def decode_resize_batch(paths: list[str], size: int, mean, std,
     return out, okb
 
 
+RAW_MEAN = (0.0, 0.0, 0.0)
+RAW_STD = (1.0 / 255.0,) * 3
+"""Identity normalization constants. The C kernel folds the scaling
+into ONE constant before touching pixels (``io_loader.cc`` —
+``scale_c = inv255 / std``, then ``out = acc * scale_c + bias``): with
+std exactly f32(1/255), ``scale_c == 1.0`` bit-exactly (x/x in IEEE)
+and mean 0 makes the bias -0.0 — so the output is the raw resampled
+value in [0, 255], untouched. It is still FRACTIONAL (triangle-filter
+output); ``decode_batch_uint8``'s rint is the actual quantization, not
+error cleanup."""
+
+
+def decode_batch_uint8(paths: list[str], size: int, n_threads: int = 0,
+                       aug_seeds: np.ndarray | None = None,
+                       aug_params: tuple = DEFAULT_AUG,
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """uint8 wire-format decode: the same native kernel driven with the
+    identity constants above, rounded to uint8 — the canonical host-side
+    batch format (``data/pipeline.py::Batch``). Normalization moved
+    in-graph (``train.make_input_prep``), so nothing downstream of the
+    decoder ever needs float pixels on the host."""
+    out, ok = decode_resize_batch(paths, size, RAW_MEAN, RAW_STD,
+                                  n_threads=n_threads, aug_seeds=aug_seeds,
+                                  aug_params=aug_params)
+    # Round-to-nearest like PIL's own uint8 resample output; the clip
+    # guards fp dust at the range edges (taps are convex weights).
+    np.rint(out, out)
+    np.clip(out, 0.0, 255.0, out=out)
+    return out.astype(np.uint8), ok
+
+
 def sample_crop(w: int, h: int, seed: int,
                 aug_params: tuple = DEFAULT_AUG) -> tuple:
     """The C sampler's (x, y, cw, ch, flip) for one (size, seed) — the

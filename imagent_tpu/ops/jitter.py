@@ -4,10 +4,12 @@ torchvision's ``ColorJitter`` runs on host CPU before normalization;
 here the jitter runs INSIDE the jitted train step (keyed off
 ``state.step`` like ops/mixing.py, so a resumed run replays the same
 draws and the host pipeline stays byte-identical across decode paths).
-The step receives NORMALIZED images, so the op un-normalizes with the
-run's (mean, std), jitters in RGB space with exact torchvision factor
-semantics, and re-normalizes — all fused by XLA into a few elementwise
-passes, zero host work.
+With the uint8 wire format the step dequantizes the batch to raw [0, 1]
+RGB before normalizing (``train.make_input_prep``), and the jitter
+operates directly on those raw values — the earlier formulation's
+un-normalize → jitter → re-normalize round-trip is gone (equivalence
+pinned by tests/test_wire_format.py). XLA fuses the whole chain into a
+few elementwise passes, zero host work.
 
 Factor semantics (torchvision ColorJitter):
   brightness: x * f,              f ~ U[max(0, 1-b), 1+b]
@@ -37,14 +39,13 @@ def _factor(key: jax.Array, strength: float, batch: int) -> jnp.ndarray:
 
 
 def color_jitter(key: jax.Array, images: jnp.ndarray,
-                 brightness: float, contrast: float, saturation: float,
-                 mean, std) -> jnp.ndarray:
-    """Jitter a normalized NHWC batch; returns the re-normalized batch
-    in the input dtype."""
+                 brightness: float, contrast: float,
+                 saturation: float) -> jnp.ndarray:
+    """Jitter a raw [0, 1] RGB NHWC batch; returns the jittered batch
+    in the input dtype (still raw [0, 1] — normalization happens after,
+    in ``train.make_input_prep``)."""
     dtype = images.dtype
-    m = jnp.asarray(mean, jnp.float32).reshape(1, 1, 1, 3)
-    s = jnp.asarray(std, jnp.float32).reshape(1, 1, 1, 3)
-    x = images.astype(jnp.float32) * s + m  # back to [0, 1] RGB
+    x = images.astype(jnp.float32)
     b = x.shape[0]
     k_b, k_c, k_s = jax.random.split(key, 3)
     # torchvision clamps after EVERY adjust_* (each blend ends in
@@ -65,14 +66,14 @@ def color_jitter(key: jax.Array, images: jnp.ndarray,
                              axes=[[3], [0]])[..., None]
         f = _factor(k_s, saturation, b)
         x = jnp.clip(gray + (x - gray) * f, 0.0, 1.0)
-    return ((x - m) / s).astype(dtype)
+    return x.astype(dtype)
 
 
 def make_jitter_fn(brightness: float = 0.0, contrast: float = 0.0,
-                   saturation: float = 0.0, mean=(0.5, 0.5, 0.5),
-                   std=(0.5, 0.5, 0.5)):
-    """``jit(key, images) -> images`` for the train step, or None when
-    all strengths are 0 (the compiled step is unchanged)."""
+                   saturation: float = 0.0):
+    """``jit(key, images01) -> images01`` for the train step's raw-RGB
+    stage, or None when all strengths are 0 (the compiled step is
+    unchanged)."""
     if min(brightness, contrast, saturation) < 0.0:
         raise ValueError(
             f"color jitter strengths must be >= 0, got "
@@ -81,7 +82,6 @@ def make_jitter_fn(brightness: float = 0.0, contrast: float = 0.0,
         return None
 
     def apply(key, images):
-        return color_jitter(key, images, brightness, contrast,
-                            saturation, mean, std)
+        return color_jitter(key, images, brightness, contrast, saturation)
 
     return apply

@@ -181,6 +181,46 @@ def state_partition_specs(state: TrainState, params_specs) -> TrainState:
     )
 
 
+_INV255 = 1.0 / 255.0
+
+
+def make_input_prep(mean=None, std=None, jitter_fn=None):
+    """In-graph input stage for the step builders: dequantize the raw
+    [0, 255]-scale wire batch (uint8 by default — see
+    ``data/pipeline.py::Batch``; bf16/f32 carry the same values) to
+    [0, 1] f32, apply photometric jitter on the raw RGB, then normalize
+    with ``(mean, std)`` baked as compile-time literals so XLA folds
+    the whole chain into the first conv's input read.
+
+    Returns ``prep(images, key=None) -> f32 normalized batch``, or
+    ``None`` when mean/std are absent — the legacy contract where
+    images arrive preprocessed (bench/unit tests that build steps
+    directly and feed normalized floats).
+
+    Every wire dtype goes through the SAME f32 ops in the same order
+    (uint8→f32 is exact, and uint8 values are exact in bf16), so the
+    uint8 path is numerically identical to the float32 A/B path —
+    pinned by tests/test_wire_format.py.
+    """
+    if mean is None and std is None:
+        if jitter_fn is not None:
+            raise ValueError("jitter_fn requires in-graph normalization: "
+                             "pass mean/std (it operates on raw [0,1] RGB)")
+        return None
+    if mean is None or std is None:
+        raise ValueError("pass both mean and std, or neither")
+    m = jnp.asarray([float(v) for v in mean], jnp.float32)
+    s = jnp.asarray([float(v) for v in std], jnp.float32)
+
+    def prep(images, key=None):
+        x = images.astype(jnp.float32) * jnp.float32(_INV255)
+        if jitter_fn is not None and key is not None:
+            x = jitter_fn(key, x)
+        return (x - m) / s
+
+    return prep
+
+
 def _target_labels(labels) -> jnp.ndarray:
     """The primary (accuracy-bearing) labels: mixed batches carry a
     ``(y_a, y_b, lam)`` triple (ops/mixing.py) whose first entry is the
@@ -228,7 +268,10 @@ def masked_eval_metrics(logits, labels, mask) -> jnp.ndarray:
     per-sample validity mask (padded eval remainders contribute nothing
     — SURVEY §7 "Eval sharding correctness"). Top-k membership via the
     rank of the target logit (strictly-greater count), the shared metric
-    body of both eval paths."""
+    body of both eval paths. ``mask`` arrives as uint8 on the wire
+    (data/pipeline.py) and is cast here, once, where floats are needed —
+    a uint8 sum would wrap at 256 valid rows per shard."""
+    mask = mask.astype(jnp.float32)
     per_sample = softmax_cross_entropy(logits, labels) * mask
     target_logit = jnp.take_along_axis(
         logits.astype(jnp.float32),
@@ -313,8 +356,16 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
                     mix_fn: Callable | None = None,
                     mix_seed: int = 0,
                     ema_decay: float = 0.0,
-                    jitter_fn: Callable | None = None) -> Callable:
+                    jitter_fn: Callable | None = None,
+                    mean=None, std=None) -> Callable:
     """Build the jitted SPMD train step.
+
+    ``mean``/``std`` (both or neither): enable the in-graph input stage
+    (``make_input_prep``) — the batch arrives on the raw [0, 255] wire
+    scale (uint8 by default) and dequantize → jitter-on-raw-RGB →
+    normalize run inside the compiled step with the constants folded by
+    XLA. Without them the legacy contract holds: images arrive
+    preprocessed (direct-build unit tests, device-resident benches).
 
     ``shard_map`` over the ``data`` axis gives each device its batch shard
     and a replicated view of the state — the exact DDP execution model,
@@ -379,6 +430,7 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
 
     loss_fn = make_loss_fn(model, label_smoothing, aux_loss_weight)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    prep = make_input_prep(mean, std, jitter_fn)
 
     def accumulate(params, batch_stats, images, labels):
         """(grads_mean, metrics_sum, new_batch_stats) over K micro-batches."""
@@ -394,24 +446,29 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     def per_device_step(state: TrainState, images, labels, lr):
         if jitter_fn is not None or mix_fn is not None:
             key = jax.random.fold_in(jax.random.key(mix_seed), state.step)
-            if jitter_fn is not None:  # ops/jitter.py, before mixing —
-                # torchvision order: photometric jitter on each source
-                # image, then the batch-level mix. Jitter factors are
-                # PER-IMAGE, so decorrelate across data shards (fold in
-                # the data position; model/pipe shards of the same rows
-                # still agree) — unlike the mix, whose lam is per-batch
-                # by design and stays replicated.
+        if prep is not None:
+            jkey = None
+            if jitter_fn is not None:  # ops/jitter.py, on raw RGB before
+                # normalize and before mixing — torchvision order:
+                # photometric jitter on each source image, then the
+                # batch-level mix. Jitter factors are PER-IMAGE, so
+                # decorrelate across data shards (fold in the data
+                # position; model/pipe shards of the same rows still
+                # agree) — unlike the mix, whose lam is per-batch by
+                # design and stays replicated.
                 jkey = jax.random.fold_in(
                     jax.random.fold_in(key, 1),
                     lax.axis_index(DATA_AXIS))
-                images = jitter_fn(jkey, images)
-            if mix_fn is not None:
-                # Key layout note: with jitter off this is the same key
-                # round-2 runs used — their checkpoints resume with the
-                # identical mixing replay.
-                mkey = (key if jitter_fn is None
-                        else jax.random.fold_in(key, 2))
-                images, labels = mix_fn(mkey, images, labels)
+            images = prep(images, jkey)
+        if mix_fn is not None:
+            # Key layout note: with jitter off this is the same key
+            # round-2 runs used — their checkpoints resume with the
+            # identical mixing replay. Mixing stays on the NORMALIZED
+            # batch: normalization is affine and the convex mix
+            # commutes with it, so the round-2 numerics are preserved.
+            mkey = (key if jitter_fn is None
+                    else jax.random.fold_in(key, 2))
+            images, labels = mix_fn(mkey, images, labels)
         grads, local, new_bs = accumulate(
             state.params, state.batch_stats, images, labels)
 
@@ -508,8 +565,12 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
                          mix_fn: Callable | None = None,
                          mix_seed: int = 0,
                          ema_decay: float = 0.0,
-                         jitter_fn: Callable | None = None) -> Callable:
+                         jitter_fn: Callable | None = None,
+                         mean=None, std=None) -> Callable:
     """FSDP train step via the XLA SPMD partitioner (``parallel/fsdp.py``).
+
+    ``mean``/``std``: same in-graph input stage as ``make_train_step``
+    (raw-scale wire batch dequantized, jittered, normalized in-graph).
 
     A PLAIN jitted function — no ``shard_map``, no axis names. Param and
     momentum shardings come from ``state_specs`` (each leaf split over
@@ -536,6 +597,7 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
 
     loss_fn = make_loss_fn(model, label_smoothing, aux_loss_weight)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    prep = make_input_prep(mean, std, jitter_fn)
     n_data = mesh.shape[DATA_AXIS]
 
     def accumulate_auto(params, batch_stats, images, labels):
@@ -567,12 +629,13 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
             # factors over the global batch in one shot (no per-shard
             # decorrelation needed here).
             key = jax.random.fold_in(jax.random.key(mix_seed), state.step)
-            if jitter_fn is not None:
-                images = jitter_fn(jax.random.fold_in(key, 1), images)
-            if mix_fn is not None:
-                mkey = (key if jitter_fn is None
-                        else jax.random.fold_in(key, 2))
-                images, labels = mix_fn(mkey, images, labels)
+        if prep is not None:
+            images = prep(images, jax.random.fold_in(key, 1)
+                          if jitter_fn is not None else None)
+        if mix_fn is not None:
+            mkey = (key if jitter_fn is None
+                    else jax.random.fold_in(key, 2))
+            images, labels = mix_fn(mkey, images, labels)
         grads, metrics, new_bs = accumulate_auto(
             state.params, state.batch_stats, images, labels)
         # Non-finite step guard — same semantics as the explicit path;
@@ -624,12 +687,18 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
 
 
 def make_eval_step_auto(model, mesh: Mesh,
-                        state_specs: TrainState) -> Callable:
+                        state_specs: TrainState,
+                        mean=None, std=None) -> Callable:
     """FSDP eval step (plain jit + shardings; masked, exact on any chip
-    count like ``make_eval_step``)."""
+    count like ``make_eval_step``). ``mean``/``std`` enable the same
+    in-graph dequantize+normalize stage as the train steps."""
     from imagent_tpu.parallel.fsdp import shardings_from_specs
 
+    prep = make_input_prep(mean, std)
+
     def eval_step(state: TrainState, images, labels, mask):
+        if prep is not None:
+            images = prep(images)
         logits = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             images, train=False)
@@ -644,16 +713,23 @@ def make_eval_step_auto(model, mesh: Mesh,
 
 
 def make_eval_step(model, mesh: Mesh,
-                   state_specs: TrainState | None = None) -> Callable:
+                   state_specs: TrainState | None = None,
+                   mean=None, std=None) -> Callable:
     """Jitted eval step (reference ``validate()``, ``imagenet.py:166-210``).
 
-    Takes an explicit per-sample validity ``mask`` so padded remainder
-    batches contribute nothing — exact on any chip count (SURVEY §7
-    "Eval sharding correctness"). Returns the same replicated
-    ``[loss_sum, top1_cnt, top5_cnt, n]`` vector as the train step.
+    Takes an explicit per-sample validity ``mask`` (uint8 on the wire,
+    cast in-graph) so padded remainder batches contribute nothing —
+    exact on any chip count (SURVEY §7 "Eval sharding correctness").
+    Returns the same replicated ``[loss_sum, top1_cnt, top5_cnt, n]``
+    vector as the train step. ``mean``/``std`` enable the in-graph
+    dequantize+normalize stage (``make_input_prep``).
     """
 
+    prep = make_input_prep(mean, std)
+
     def per_device_eval(state: TrainState, images, labels, mask):
+        if prep is not None:
+            images = prep(images)
         logits = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             images, train=False)

@@ -32,9 +32,16 @@ class TrainLogger:
         line = (f"Epoch {epoch + 1}: lr {lr:g} | "
                 f"train loss {train['loss']:.4f} top1 {train['top1']:.3f} "
                 f"top5 {train['top5']:.3f} time {train_time:.1f}s")
+        if "host_blocked_s" in train:
+            # Data-starvation counters (data/prefetch.py::PrefetchStats):
+            # input_wait ≈ epoch time ⇒ the run is input-bound.
+            line += (f" input_wait {train['host_blocked_s']:.1f}s "
+                     f"h2d {train['h2d_bytes'] / 1e9:.2f}GB")
         if val is not None:
             line += (f" | val loss {val['loss']:.4f} top1 {val['top1']:.3f} "
                      f"top5 {val['top5']:.3f} time {val_time:.1f}s")
+            if "host_blocked_s" in val:
+                line += f" input_wait {val['host_blocked_s']:.1f}s"
         print(line, flush=True)
 
     def scalars(self, epoch: int, lr: float, train: dict,
@@ -51,6 +58,19 @@ class TrainLogger:
                 series["test"] = val[key]
             self.writer.add_scalars(group, series, epoch)
         self.writer.add_scalar("lr", lr, epoch)
+        if "host_blocked_s" in train:
+            # Input-pipeline health series: blocked time trending up at
+            # constant h2d volume = the host side is falling behind.
+            self.writer.add_scalar("data/host_blocked_s",
+                                   train["host_blocked_s"], epoch)
+            self.writer.add_scalar("data/h2d_mb",
+                                   train["h2d_bytes"] / 1e6, epoch)
+        if val is not None and "host_blocked_s" in val:
+            # Val often reads a different storage path — its own series.
+            self.writer.add_scalar("data/val_host_blocked_s",
+                                   val["host_blocked_s"], epoch)
+            self.writer.add_scalar("data/val_h2d_mb",
+                                   val["h2d_bytes"] / 1e6, epoch)
         self.writer.flush()
 
     def final_summary(self, best_epoch: int, best_top1: float,

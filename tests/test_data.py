@@ -44,12 +44,13 @@ def test_shard_indices_eval_keeps_all():
 
 
 def test_pad_batch():
-    img = np.ones((3, 4, 4, 3), np.float32)
+    img = np.ones((3, 4, 4, 3), np.uint8)
     lbl = np.arange(3, dtype=np.int32)
     b = pad_batch(img, lbl, 8)
     assert b.images.shape == (8, 4, 4, 3)
-    assert b.mask.sum() == 3.0
-    assert (b.mask[:3] == 1.0).all() and (b.mask[3:] == 0.0).all()
+    assert b.mask.dtype == np.uint8  # 0/1 semantics, 1 byte on the wire
+    assert b.mask.sum() == 3
+    assert (b.mask[:3] == 1).all() and (b.mask[3:] == 0).all()
 
 
 def test_synthetic_loader_shapes_and_determinism():
@@ -93,10 +94,10 @@ def test_imagefolder_scan_and_decode(tmp_path):
     batches = list(ld.epoch(0))
     assert len(batches) == 3
     assert batches[0].images.shape == (2, 16, 16, 3)
-    assert batches[0].images.dtype == np.float32
-    # Normalize((.5,.5,.5),(.5,.5,.5)) maps [0,1] → [-1,1] (imagenet.py:283).
-    assert batches[0].images.min() >= -1.0 - 1e-6
-    assert batches[0].images.max() <= 1.0 + 1e-6
+    # uint8 wire contract: raw pixels, normalization is in-graph
+    # (train.make_input_prep), 4x fewer host/H2D bytes than float32.
+    assert batches[0].images.dtype == np.uint8
+    assert batches[0].images.max() > 1  # raw [0, 255] scale, not [0, 1]
 
     val = ImageFolderLoader(cfg, 0, 1, global_batch=4, split="val")
     vb = list(val.epoch(0))
@@ -123,8 +124,9 @@ def test_shard_indices_equal_batches_across_processes():
     assert sorted(seen) == list(range(9))  # all samples exactly once
 
 
-def test_input_bf16_batches():
-    """--input-bf16: loaders emit bfloat16 image batches (halved H2D);
+def test_transfer_dtype_bf16_batches():
+    """--transfer-dtype bf16: loaders emit bfloat16 image batches still
+    on the raw [0, 255] scale (uint8 values are exact in bf16);
     labels/mask dtypes unchanged."""
     import ml_dtypes
 
@@ -132,12 +134,13 @@ def test_input_bf16_batches():
     from imagent_tpu.data.synthetic import SyntheticLoader
 
     cfg = Config(dataset="synthetic", synthetic_size=16, image_size=8,
-                 num_classes=4, batch_size=4, input_bf16=True)
+                 num_classes=4, batch_size=4, transfer_dtype="bf16")
     loader = SyntheticLoader(cfg, 0, 1, global_batch=8, train=True)
     batch = next(iter(loader.epoch(0)))
     assert batch.images.dtype == ml_dtypes.bfloat16
+    assert float(batch.images.astype(np.float32).max()) > 1.0  # raw scale
     assert batch.labels.dtype == np.int32
-    assert batch.mask.dtype == np.float32
+    assert batch.mask.dtype == np.uint8
 
 
 def test_device_prefetch_matches_direct_sharding():

@@ -20,6 +20,8 @@ def _cfg(**kw):
     (dict(grad_accum=0), "--grad-accum"),
     (dict(color_jitter=(0.4, -0.1, 0.2)), "--color-jitter"),
     (dict(color_jitter=(0.4, 0.4)), "--color-jitter"),
+    (dict(transfer_dtype="fp8"), "--transfer-dtype"),
+    (dict(prefetch_depth=0), "--prefetch-depth"),
     (dict(seq_parallel="ring"), "--seq-parallel requires"),
     (dict(attn="flash"), "--attn.*requires a ViT"),
     (dict(arch="vit_b16", attn="flash", seq_parallel="ring",

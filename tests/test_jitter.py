@@ -1,25 +1,18 @@
 """In-graph color jitter (ops/jitter.py): torchvision factor semantics
-on normalized batches (un-normalize → jitter → re-normalize in-graph).
+on RAW [0, 1] RGB batches — the jitter runs after the in-graph
+dequantize and before normalization (train.make_input_prep), so the
+old un-normalize → jitter → re-normalize round-trip is gone (its
+equivalence to this formulation is pinned in tests/test_wire_format.py).
 """
 
 import pytest
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from imagent_tpu.ops.jitter import color_jitter, make_jitter_fn
 
-MEAN = STD = (0.5, 0.5, 0.5)
 B, H, W = 4, 8, 8
-
-
-def _norm(x):
-    return (x - 0.5) / 0.5
-
-
-def _unnorm(y):
-    return np.asarray(y) * 0.5 + 0.5
 
 
 def _batch(lo=0.2, hi=0.6, seed=0):
@@ -29,17 +22,15 @@ def _batch(lo=0.2, hi=0.6, seed=0):
 
 def test_zero_strength_is_identity():
     x = _batch()
-    y = color_jitter(jax.random.key(0), jnp.asarray(_norm(x)),
-                     0.0, 0.0, 0.0, MEAN, STD)
-    np.testing.assert_allclose(np.asarray(y), _norm(x), atol=1e-6)
+    y = color_jitter(jax.random.key(0), x, 0.0, 0.0, 0.0)
+    np.testing.assert_allclose(np.asarray(y), x, atol=1e-6)
     assert make_jitter_fn(0.0, 0.0, 0.0) is None
 
 
 def test_brightness_factor_semantics():
     """Brightness multiplies each image by one factor in [1-b, 1+b]."""
     x = _batch()  # values <= 0.6, b=0.3 -> max 0.78, no clipping
-    y = _unnorm(color_jitter(jax.random.key(1), jnp.asarray(_norm(x)),
-                             0.3, 0.0, 0.0, MEAN, STD))
+    y = np.asarray(color_jitter(jax.random.key(1), x, 0.3, 0.0, 0.0))
     ratios = y / x
     for i in range(B):
         f = ratios[i].mean()
@@ -52,8 +43,7 @@ def test_brightness_factor_semantics():
 def test_contrast_preserves_constant_images():
     """A constant image IS its own gray-mean anchor: contrast no-op."""
     x = np.full((B, H, W, 3), 0.4, np.float32)
-    y = _unnorm(color_jitter(jax.random.key(2), jnp.asarray(_norm(x)),
-                             0.0, 0.9, 0.0, MEAN, STD))
+    y = np.asarray(color_jitter(jax.random.key(2), x, 0.0, 0.9, 0.0))
     np.testing.assert_allclose(y, x, atol=1e-5)
 
 
@@ -61,21 +51,20 @@ def test_saturation_preserves_gray_images():
     """R=G=B images equal their grayscale: saturation no-op."""
     g = _batch()[..., :1]
     x = np.repeat(g, 3, axis=-1)
-    y = _unnorm(color_jitter(jax.random.key(3), jnp.asarray(_norm(x)),
-                             0.0, 0.0, 0.9, MEAN, STD))
+    y = np.asarray(color_jitter(jax.random.key(3), x, 0.0, 0.0, 0.9))
     np.testing.assert_allclose(y, x, atol=1e-5)
 
 
 def test_output_clamped_to_image_range():
     x = _batch(0.7, 1.0)  # bright inputs, strong brightness -> clips
-    y = _unnorm(color_jitter(jax.random.key(4), jnp.asarray(_norm(x)),
-                             0.9, 0.0, 0.0, MEAN, STD))
+    y = np.asarray(color_jitter(jax.random.key(4), x, 0.9, 0.0, 0.0))
     assert y.max() <= 1.0 + 1e-6 and y.min() >= -1e-6
 
 
 def test_jitter_deterministic_and_dtype_preserving():
-    x = jnp.asarray(_norm(_batch())).astype(jnp.bfloat16)
-    f = make_jitter_fn(0.4, 0.4, 0.4, MEAN, STD)
+    import jax.numpy as jnp
+    x = jnp.asarray(_batch()).astype(jnp.bfloat16)
+    f = make_jitter_fn(0.4, 0.4, 0.4)
     y1 = f(jax.random.key(5), x)
     y2 = f(jax.random.key(5), x)
     assert y1.dtype == jnp.bfloat16

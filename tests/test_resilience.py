@@ -317,7 +317,7 @@ def test_decode_retry_rescues_transient_fault(tmp_path):
     rng = np.random.default_rng(0)
     p = str(tmp_path / "img.png")
     _write_png(p, rng)
-    _init_worker(16, (0.5, 0.5, 0.5), (0.5, 0.5, 0.5))
+    _init_worker(16)
 
     # One injected failure: the retry's second attempt succeeds.
     faultinject.configure("corrupt-image:times=1")

@@ -1,0 +1,226 @@
+"""uint8 wire format (ISSUE 2): the host pipeline ships raw uint8 NHWC
+end-to-end and dequantize+normalize run inside the jitted steps
+(train.make_input_prep).
+
+Pins three things:
+  (a) the Batch dtype CONTRACT — a regression back to float32 on the
+      wire fails loudly here;
+  (b) numerical parity between the uint8 wire and the --transfer-dtype
+      float32/bf16 A/B paths, for BOTH step builders (shard_map and the
+      FSDP auto step) and the eval step — same f32 math, same op order;
+  (c) jitter-on-raw-RGB equivalence with the old un-normalize → jitter
+      → re-normalize formulation that ops/jitter.py used to implement.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from imagent_tpu.cluster import make_mesh
+from imagent_tpu.config import Config
+from imagent_tpu.data.pipeline import to_wire
+from imagent_tpu.data.synthetic import SyntheticLoader
+from imagent_tpu.train import (
+    create_train_state, make_eval_step, make_input_prep, make_optimizer,
+    make_train_step, make_train_step_auto, replicate_state, shard_batch,
+)
+
+CLASSES, SIZE, BATCH = 4, 32, 16
+MEAN = STD = (0.5, 0.5, 0.5)
+
+
+class _WireCNN(nn.Module):
+    """BN-free conv net (as in test_train.py): numerically
+    well-conditioned, so wire-dtype parity is exact to f32 tolerance."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(16, (3, 3))(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(CLASSES)(x)
+
+
+def _synthetic_u8(n=BATCH):
+    """A real synthetic-dataset batch (uint8 wire) + labels."""
+    cfg = Config(dataset="synthetic", synthetic_size=max(n, 32),
+                 image_size=SIZE, num_classes=CLASSES)
+    loader = SyntheticLoader(cfg, 0, 1, global_batch=n, train=True)
+    b = next(iter(loader.epoch(0)))
+    assert b.images.dtype == np.uint8  # the contract under test
+    return b.images, b.labels
+
+
+def test_batch_dtype_contract():
+    """(a) Default wire is uint8 from every loader; mask is uint8; the
+    A/B dtypes carry the SAME raw [0, 255] integer values."""
+    assert Config().transfer_dtype == "uint8"
+    images, labels = _synthetic_u8()
+    assert images.dtype == np.uint8 and labels.dtype == np.int32
+
+    f32 = to_wire(images, "float32")
+    assert f32.dtype == np.float32
+    np.testing.assert_array_equal(f32, np.rint(f32))  # integer values
+    assert f32.max() > 1.0  # raw scale, not [0, 1] or normalized
+    import ml_dtypes
+    bf16 = to_wire(images, "bf16")
+    assert bf16.dtype == ml_dtypes.bfloat16
+    # every uint8 is exact in bf16 — the cast is lossless
+    np.testing.assert_array_equal(bf16.astype(np.float32), f32)
+    with pytest.raises(ValueError, match="transfer-dtype"):
+        to_wire(images, "fp8")
+
+    # eval tail batch: uint8 0/1 mask on the wire
+    cfg = Config(dataset="synthetic", synthetic_size=40, image_size=8,
+                 num_classes=CLASSES)
+    val = SyntheticLoader(cfg, 0, 1, global_batch=16, train=False)
+    tail = list(val.epoch(0))[-1]
+    assert tail.mask.dtype == np.uint8
+    assert set(np.unique(tail.mask)) <= {0, 1}
+
+
+def test_imagefolder_pil_path_emits_uint8(tmp_path):
+    """(a) The PIL decode path (no native lib, in-process) returns the
+    decoded array untouched — uint8 through worker IPC and the queue."""
+    from PIL import Image
+
+    from imagent_tpu.data.imagefolder import ImageFolderLoader
+    rng = np.random.default_rng(0)
+    for split in ("train", "val"):
+        d = tmp_path / split / "only"
+        d.mkdir(parents=True)
+        for i in range(2):
+            Image.fromarray(rng.integers(0, 255, (20, 20, 3),
+                                         dtype=np.uint8)).save(d / f"{i}.jpg")
+    cfg = Config(image_size=16, num_classes=1, data_root=str(tmp_path),
+                 workers=0, native_io=False)
+    ld = ImageFolderLoader(cfg, 0, 1, global_batch=2, split="train")
+    b = next(iter(ld.epoch(0)))
+    assert b.images.dtype == np.uint8
+    assert b.images.max() > 1  # raw pixels, not normalized
+
+
+def _run_step(mesh, step, state, images, labels):
+    gi, gl = shard_batch(mesh, images, labels)
+    new_state, metrics = step(state, gi, gl, np.float32(0.1))
+    return jax.device_get(new_state.params), np.asarray(metrics)
+
+
+def test_wire_parity_shard_map_step():
+    """(b) uint8 vs float32 vs bf16 wire through make_train_step: the
+    in-graph dequantize sees identical f32 values, so logits/loss/
+    update match to f32 tolerance (the synthetic dataset, per ISSUE)."""
+    mesh = make_mesh(model_parallel=1)
+    model = _WireCNN()
+    opt = make_optimizer()
+    images, labels = _synthetic_u8()
+    step = make_train_step(model, opt, mesh, mean=MEAN, std=STD)
+
+    results = {}
+    for wire in ("uint8", "float32", "bf16"):
+        state = replicate_state(
+            create_train_state(model, jax.random.key(0), SIZE, opt), mesh)
+        results[wire] = _run_step(mesh, step, state,
+                                  to_wire(images, wire), labels)
+    p_u8, m_u8 = results["uint8"]
+    for wire in ("float32", "bf16"):
+        p, m = results[wire]
+        np.testing.assert_allclose(m, m_u8, rtol=1e-6, atol=1e-6)
+        for a, b in zip(jax.tree.leaves(p_u8), jax.tree.leaves(p)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_wire_parity_auto_step():
+    """(b) Same parity through the FSDP auto step builder."""
+    from imagent_tpu.parallel.fsdp import fsdp_state_specs
+    from imagent_tpu.train import place_state
+
+    mesh = make_mesh(devices=jax.devices()[:4])
+    model = _WireCNN()
+    opt = make_optimizer(name="adamw")
+    images, labels = _synthetic_u8()
+    host = jax.device_get(
+        create_train_state(model, jax.random.key(0), SIZE, opt))
+    specs = fsdp_state_specs(host, 4)
+    step = make_train_step_auto(model, opt, mesh, specs,
+                                mean=MEAN, std=STD)
+
+    results = {}
+    for wire in ("uint8", "float32"):
+        state = place_state(jax.device_get(host), mesh, specs)
+        results[wire] = _run_step(mesh, step, state,
+                                  to_wire(images, wire), labels)
+    (p_u8, m_u8), (p_f32, m_f32) = results["uint8"], results["float32"]
+    np.testing.assert_allclose(m_u8, m_f32, rtol=1e-6, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_u8), jax.tree.leaves(p_f32)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_eval_step_uint8_wire_and_mask():
+    """(b) Eval: uint8 images + uint8 mask give the same metrics as the
+    float32 wire with a float mask (the in-graph casts are exact)."""
+    mesh = make_mesh(model_parallel=1)
+    model = _WireCNN()
+    opt = make_optimizer()
+    state = replicate_state(
+        create_train_state(model, jax.random.key(0), SIZE, opt), mesh)
+    images, labels = _synthetic_u8()
+    eval_step = make_eval_step(model, mesh, mean=MEAN, std=STD)
+
+    mask_u8 = np.ones((BATCH,), np.uint8)
+    mask_u8[-3:] = 0  # padded tail
+    got = np.asarray(eval_step(
+        state, *shard_batch(mesh, images, labels, mask_u8)))
+    want = np.asarray(eval_step(
+        state, *shard_batch(mesh, to_wire(images, "float32"), labels,
+                            mask_u8.astype(np.float32))))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    assert got[3] == BATCH - 3  # the masked rows contributed nothing
+
+
+def test_jitter_on_raw_rgb_matches_unnormalize_roundtrip():
+    """(c) The re-ordered jitter (raw [0,1] RGB, pre-normalize) equals
+    the deleted formulation: un-normalize the normalized batch, jitter,
+    re-normalize — same draws, same factors, to fp32 round-off."""
+    from imagent_tpu.ops.jitter import color_jitter, make_jitter_fn
+
+    mean = (0.485, 0.456, 0.406)
+    std = (0.229, 0.224, 0.225)
+    images, _ = _synthetic_u8()
+    key = jax.random.key(11)
+    b, c, s = 0.4, 0.4, 0.2
+
+    prep = make_input_prep(mean, std, make_jitter_fn(b, c, s))
+    got = np.asarray(prep(jnp.asarray(images), key))
+
+    # Old pipeline: host normalized the batch, the step un-normalized,
+    # jittered in RGB, re-normalized (ops/jitter.py pre-ISSUE-2).
+    m = np.asarray(mean, np.float32)
+    sd = np.asarray(std, np.float32)
+    x01 = images.astype(np.float32) / 255.0
+    x_norm = (x01 - m) / sd
+    x_rt = jnp.asarray(x_norm) * sd + m  # the step's un-normalize
+    jittered = color_jitter(key, x_rt, b, c, s)
+    want = (np.asarray(jittered) - m) / sd
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_make_input_prep_contract():
+    """Legacy escape hatch: no mean/std = no-op (direct-build tests feed
+    preprocessed floats); jitter without mean/std is a loud error."""
+    from imagent_tpu.ops.jitter import make_jitter_fn
+
+    assert make_input_prep() is None
+    with pytest.raises(ValueError, match="mean/std"):
+        make_input_prep(jitter_fn=make_jitter_fn(0.1, 0.0, 0.0))
+    with pytest.raises(ValueError, match="both"):
+        make_input_prep(mean=(0.5, 0.5, 0.5))
+    prep = make_input_prep(MEAN, STD)
+    u8 = np.arange(2 * 2 * 2 * 3, dtype=np.uint8).reshape(2, 2, 2, 3)
+    out = np.asarray(prep(jnp.asarray(u8)))
+    np.testing.assert_allclose(
+        out, (u8.astype(np.float32) / 255.0 - 0.5) / 0.5, atol=1e-6)
