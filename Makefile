@@ -4,12 +4,27 @@
 PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
 
-.PHONY: smoke test bench-smoke
+.PHONY: smoke test lint bench-smoke
 
-# Fast confidence tier (<5 min on CPU): the resilience unit tests, the
-# end-to-end fault-injection drills (torn checkpoint, NaN rollback,
-# watchdog, SIGTERM), and the core e2e train/resume smoke.
-smoke:
+# Static-analysis gate (docs/STATIC_ANALYSIS.md): jaxlint — the
+# JAX/TPU-aware rules in imagent_tpu/analysis — over the package, the
+# benchmarks, and the bench driver; exit != 0 on any unsuppressed
+# finding. ruff (correctness classes only, [tool.ruff] in
+# pyproject.toml) rides along when the binary exists; the CI image
+# doesn't ship it, so its absence is a skip, not a failure.
+lint:
+	$(PY) -m imagent_tpu.analysis imagent_tpu benchmarks bench.py
+	@if command -v ruff >/dev/null 2>&1; then \
+	    ruff check imagent_tpu benchmarks tests bench.py; \
+	else \
+	    echo "ruff not installed; skipping (jaxlint gate enforced above)"; \
+	fi
+
+# Fast confidence tier (<5 min on CPU): the lint gate, the resilience
+# unit tests, the end-to-end fault-injection drills (torn checkpoint,
+# NaN rollback, watchdog, SIGTERM), and the core e2e train/resume
+# smoke.
+smoke: lint
 	$(PYTEST) -m "not slow" tests/test_resilience.py \
 	    tests/test_fault_drills.py tests/test_e2e.py
 

@@ -38,7 +38,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-from functools import partial
 
 import numpy as np
 
@@ -65,11 +64,11 @@ def measure_stage(name: str, hw: int, c: int, n_blocks: int, batch: int,
     import jax.numpy as jnp
     from jax import lax
 
-    key = jax.random.key(0)
-    x = jax.random.normal(key, (batch, hw, hw, c), jnp.bfloat16)
-    wdw = jax.random.normal(key, (7, 7, 1, c), jnp.bfloat16) * 0.05
-    w1 = jax.random.normal(key, (c, 4 * c), jnp.bfloat16) * 0.05
-    w2 = jax.random.normal(key, (4 * c, c), jnp.bfloat16) * 0.05
+    k_x, k_dw, k_1, k_2 = jax.random.split(jax.random.key(0), 4)
+    x = jax.random.normal(k_x, (batch, hw, hw, c), jnp.bfloat16)
+    wdw = jax.random.normal(k_dw, (7, 7, 1, c), jnp.bfloat16) * 0.05
+    w1 = jax.random.normal(k_1, (c, 4 * c), jnp.bfloat16) * 0.05
+    w2 = jax.random.normal(k_2, (4 * c, c), jnp.bfloat16) * 0.05
     scale = jnp.ones((c,), jnp.bfloat16)
     gamma = jnp.full((c,), 1e-2, jnp.bfloat16)
     dn = lax.conv_dimension_numbers(x.shape, wdw.shape,

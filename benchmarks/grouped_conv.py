@@ -93,9 +93,9 @@ def measure_stage(name: str, hw: int, width: int, batch: int,
     from jax import lax
 
     cg = width // GROUPS
-    key = jax.random.key(0)
-    x = jax.random.normal(key, (batch, hw, hw, width), jnp.bfloat16)
-    w = jax.random.normal(key, (3, 3, cg, width), jnp.bfloat16) * 0.05
+    k_x, k_w = jax.random.split(jax.random.key(0))
+    x = jax.random.normal(k_x, (batch, hw, hw, width), jnp.bfloat16)
+    w = jax.random.normal(k_w, (3, 3, cg, width), jnp.bfloat16) * 0.05
     dn = lax.conv_dimension_numbers(x.shape, w.shape,
                                     ("NHWC", "HWIO", "NHWC"))
 

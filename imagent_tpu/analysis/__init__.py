@@ -1,0 +1,18 @@
+"""jaxlint: JAX/TPU-aware static analysis (the CI lint gate).
+
+An AST-walking lint framework with rules for the defect classes that
+only surface at pod scale — host syncs inside jitted bodies, PRNG key
+reuse, recompile hazards, nondeterministic pytree ordering, missing
+buffer donation, wire-format dtype drift, and unsynced benchmark
+timing.  Run ``python -m imagent_tpu.analysis`` (or ``make lint``);
+rules and workflow are documented in docs/STATIC_ANALYSIS.md.
+
+Deliberately jax-free: the linter parses source, it never imports the
+code under analysis, so it runs in milliseconds and can gate CI before
+any backend exists.
+"""
+
+from imagent_tpu.analysis.rules import RULES, Finding, Rule  # noqa: F401
+from imagent_tpu.analysis.runner import (  # noqa: F401
+    LintResult, lint_file, run_paths,
+)

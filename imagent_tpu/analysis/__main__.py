@@ -1,0 +1,7 @@
+"""``python -m imagent_tpu.analysis`` — the jaxlint CI gate."""
+
+import sys
+
+from imagent_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,113 @@
+"""jaxlint CLI: ``python -m imagent_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/configuration error — so
+``make lint`` is a hard CI gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import os
+
+from imagent_tpu.analysis.rules import RULES
+from imagent_tpu.analysis.runner import (
+    DEFAULT_BASELINE, load_baseline, run_paths, write_baseline,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m imagent_tpu.analysis",
+        description="jaxlint: JAX/TPU-aware static analysis "
+                    "(docs/STATIC_ANALYSIS.md)")
+    p.add_argument("paths", nargs="*",
+                   default=["imagent_tpu", "benchmarks"],
+                   help="files/directories to lint (default: "
+                        "imagent_tpu benchmarks, from the repo root)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="grandfathered-findings file (default: "
+                        "imagent_tpu/analysis/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="snapshot current findings into --baseline "
+                        "(reasons stamped TODO — edit before commit)")
+    p.add_argument("--select", metavar="RULE[,RULE...]",
+                   help="run only these rules")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print each rule and why it bites on TPU")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="summary line only")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        width = max(len(n) for n in RULES)
+        for name, rule in sorted(RULES.items()):
+            print(f"{name:<{width}}  {rule.doc}")
+        return 0
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+        unknown = select - set(RULES)
+        if unknown:
+            print(f"jaxlint: unknown rule(s): {', '.join(sorted(unknown))}"
+                  f" (see --list-rules)", file=sys.stderr)
+            return 2
+    # --write-baseline snapshots the complete current state, so the
+    # existing baseline must not pre-filter what gets written.
+    if args.write_baseline and select is not None:
+        # A partial-rule snapshot would silently drop every other
+        # rule's grandfathered entries (and their justifications).
+        print("jaxlint: --write-baseline cannot be combined with "
+              "--select: the baseline is a whole-tree snapshot",
+              file=sys.stderr)
+        return 2
+    baseline = None if (args.no_baseline or args.write_baseline) \
+        else args.baseline
+    try:
+        result = run_paths(args.paths, baseline_path=baseline,
+                           select=select)
+    except (ValueError, OSError) as e:
+        print(f"jaxlint: {e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        prior: list = []
+        if os.path.exists(args.baseline):
+            try:  # carry hand-written reasons forward across rewrites
+                prior = load_baseline(args.baseline)
+            except ValueError:
+                prior = []  # malformed old file: rewrite from scratch
+        skipped = write_baseline(result, args.baseline, prior)
+        n = len(result.findings) - skipped
+        print(f"jaxlint: wrote {n} baseline "
+              f"entr{'y' if n == 1 else 'ies'} to "
+              f"{args.baseline} — fill in each TODO reason")
+        if skipped:
+            print(f"jaxlint: {skipped} meta-finding(s) "
+                  "(bare-suppression / syntax-error) NOT grandfathered "
+                  "— fix them at the source", file=sys.stderr)
+        return 0
+    if not args.quiet:
+        for f in result.findings:
+            print(f.render())
+        for e in result.stale_baseline:
+            print(f"jaxlint: stale baseline entry ({e['rule']} @ "
+                  f"{e['path']}): no longer matches — delete it",
+                  file=sys.stderr)
+        for spath, sline in result.unused_suppressions:
+            print(f"jaxlint: unused suppression at {spath}:{sline}: "
+                  "no finding matches — delete the comment",
+                  file=sys.stderr)
+    print(f"jaxlint: {len(result.findings)} finding(s) "
+          f"({result.baselined} baselined, {result.suppressed} "
+          f"suppressed) across {result.files_checked} file(s)")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,243 @@
+"""jaxlint runner: file discovery, suppressions, baseline, orchestration.
+
+Suppression syntax (same line as the finding)::
+
+    x = np.asarray(y)  # jaxlint: disable=host-sync-in-jit -- <why>
+
+``disable=all`` silences every rule on that line.  The ``-- <why>``
+justification is required: a suppression without one is itself reported
+(``bare-suppression``), so silenced findings stay auditable.
+
+Baseline (``analysis/baseline.json``): a JSON list of
+``{"path", "rule", "code", "reason"}`` entries for grandfathered
+findings — matched by (path, rule, stripped source line), so entries
+survive line drift.  Every entry must carry a non-empty ``reason``.
+Entries that no longer match anything are reported as stale (the fix
+landed: delete the entry) without failing the run.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Iterable, Iterator
+
+from imagent_tpu.analysis.rules import RULES, Finding, ModuleContext
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\- ]+?)"
+    r"(?:\s*--\s*(.*?))?\s*$")
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baseline.json")
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]          # actionable (unsuppressed) hits
+    suppressed: int
+    baselined: int
+    stale_baseline: list[dict]
+    files_checked: int
+    # Suppression comments no finding consumed — the fix landed, so
+    # the comment should go (reported like stale baseline entries).
+    unused_suppressions: list[tuple[str, int]] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            # An explicitly named file is linted regardless of
+            # extension (extensionless scripts included) — skipping it
+            # silently would let the CI gate pass while checking
+            # nothing; non-Python content surfaces as a syntax-error
+            # finding.
+            yield path
+            continue
+        if not os.path.isdir(path):
+            # A typo'd path silently yielding nothing would let the CI
+            # gate pass while checking nothing — fail loudly instead.
+            raise FileNotFoundError(
+                f"lint path does not exist: {path!r}")
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__"
+                             and not d.startswith("."))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def parse_suppressions(
+        source: str) -> tuple[dict[int, set[str]], list[int]]:
+    """Line → suppressed rule names, plus lines whose suppression has
+    no ``-- why`` justification (reported, not honored silently).
+
+    Tokenized, not line-scanned: only real ``#`` comments count, so a
+    suppression example quoted inside a docstring is inert."""
+    by_line: dict[int, set[str]] = {}
+    unjustified: list[int] = []
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):
+        return by_line, unjustified  # unparseable: no suppressions
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        i = tok.start[0]
+        names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+        by_line[i] = names
+        if not (m.group(2) or "").strip():
+            unjustified.append(i)
+    return by_line, unjustified
+
+
+def load_baseline(path: str) -> list[dict]:
+    """Validated baseline entries.  Raises ValueError on a malformed
+    file or an entry missing its justification."""
+    with open(path, encoding="utf-8") as f:
+        entries = json.load(f)
+    if not isinstance(entries, list):
+        raise ValueError(f"{path}: baseline must be a JSON list")
+    for i, e in enumerate(entries):
+        for field in ("path", "rule", "code", "reason"):
+            if not isinstance(e.get(field), str) or not e[field].strip():
+                raise ValueError(
+                    f"{path}: entry {i} needs a non-empty {field!r} "
+                    "(every grandfathered finding carries its "
+                    "justification)")
+        if e["rule"] not in RULES:
+            raise ValueError(
+                f"{path}: entry {i} names unknown rule {e['rule']!r}")
+    return entries
+
+
+def lint_file(path: str, rel_path: str,
+              select: set[str] | None = None
+              ) -> tuple[list[Finding], int, list[int]]:
+    """(actionable findings, suppressed count, unused-suppression
+    lines) for one file.  Syntax errors surface as a finding on the
+    offending line rather than crashing the whole run.
+
+    A suppression applies to any finding whose statement extent
+    ``[line, end_line]`` covers the comment's line, so the idiomatic
+    placement at the END of a multiline call works."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rel_path, e.lineno or 1, e.offset or 0,
+                        "syntax-error", f"cannot parse: {e.msg}")], 0, []
+    ctx = ModuleContext(rel_path, source, tree)
+    raw: list[Finding] = []
+    for name, rule in RULES.items():
+        if select is not None and name not in select:
+            continue
+        raw.extend(rule.check(ctx))
+    by_line, unjustified = parse_suppressions(source)
+    kept: list[Finding] = []
+    suppressed = 0
+    used_lines: set[int] = set()
+    for f_ in sorted(raw, key=lambda f_: (f_.line, f_.col, f_.rule)):
+        hit = next(
+            (ln for ln in range(f_.line, max(f_.end_line, f_.line) + 1)
+             if "all" in by_line.get(ln, ())
+             or f_.rule in by_line.get(ln, ())), None)
+        if hit is not None:
+            suppressed += 1
+            used_lines.add(hit)
+        else:
+            kept.append(f_)
+    for line in unjustified:
+        code = source.splitlines()[line - 1].strip()
+        kept.append(Finding(
+            rel_path, line, 0, "bare-suppression",
+            "suppression without a `-- <why>` justification: silenced "
+            "findings must stay auditable", code, line))
+    # Unused-suppression audit only makes sense with every rule armed:
+    # under --select, other rules' suppressions are legitimately idle.
+    unused = [] if select is not None else \
+        [ln for ln in by_line
+         if ln not in used_lines and ln not in unjustified]
+    return kept, suppressed, unused
+
+
+def run_paths(paths: Iterable[str], baseline_path: str | None = None,
+              select: set[str] | None = None,
+              root: str | None = None) -> LintResult:
+    """Lint every .py under ``paths``; apply suppressions + baseline."""
+    root = root or os.getcwd()
+    baseline = load_baseline(baseline_path) if baseline_path and \
+        os.path.exists(baseline_path) else []
+    matched: set[int] = set()
+    findings: list[Finding] = []
+    unused_supp: list[tuple[str, int]] = []
+    suppressed = 0
+    n_files = 0
+    for path in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), root)
+        rel = rel.replace(os.sep, "/")
+        n_files += 1
+        kept, supp, unused = lint_file(path, rel, select)
+        suppressed += supp
+        unused_supp.extend((rel, ln) for ln in sorted(unused))
+        for f_ in kept:
+            hit = next(
+                (i for i, e in enumerate(baseline)
+                 if i not in matched and e["path"] == f_.path
+                 and e["rule"] == f_.rule and e["code"] == f_.code),
+                None)
+            if hit is not None:
+                matched.add(hit)
+            else:
+                findings.append(f_)
+    stale = [e for i, e in enumerate(baseline) if i not in matched]
+    return LintResult(findings, suppressed, len(matched), stale,
+                      n_files, unused_supp)
+
+
+def write_baseline(result: LintResult, path: str,
+                   prior: Iterable[dict] = ()) -> int:
+    """Snapshot current findings as baseline entries; returns how many
+    meta-findings were NOT grandfathered.
+
+    ``prior`` (the previous baseline's entries) carries hand-written
+    justifications forward for findings whose (path, rule, code)
+    fingerprint is unchanged; new entries are stamped TODO —
+    ``load_baseline`` accepts them (non-empty) but the PR review should
+    replace each with the real justification.  Meta-findings
+    (``bare-suppression``, ``syntax-error``) are skipped: they are not
+    grandfatherable (``load_baseline`` rejects their rule names) and
+    must be fixed at the source."""
+    kept_reasons = {(e["path"], e["rule"], e["code"]): e["reason"]
+                    for e in prior}
+    entries = []
+    skipped = 0
+    for f_ in result.findings:
+        if f_.rule not in RULES:
+            skipped += 1
+            continue
+        entries.append({
+            "path": f_.path, "rule": f_.rule, "code": f_.code,
+            "reason": kept_reasons.get(
+                (f_.path, f_.rule, f_.code),
+                "TODO: justify this grandfathered finding")})
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(entries, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return skipped

@@ -89,11 +89,13 @@ def scan_tar_split(split_dir: str):
                       np.int64)
     keep = labels >= 0
     order = np.argsort(np.asarray(names, object)[keep], kind="stable")
+    # Explicit dtypes: offsets/sizes are byte positions into multi-GB
+    # shards (int64 by necessity, not by platform default).
     return (shards,
             np.asarray(names, object)[keep][order],
-            np.asarray(shard_of)[keep][order],
-            np.asarray(offsets)[keep][order],
-            np.asarray(sizes)[keep][order],
+            np.asarray(shard_of, np.int32)[keep][order],
+            np.asarray(offsets, np.int64)[keep][order],
+            np.asarray(sizes, np.int64)[keep][order],
             labels[keep][order],
             classes)
 

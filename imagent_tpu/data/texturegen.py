@@ -85,7 +85,7 @@ def texture_pair(cls: int, idx: int, n_classes: int, img: int,
     # Binary occupancy mask: coarse noise upsampled 3x (correlation
     # length ~3px), thresholded so the dominant hue covers ~70%.
     coarse = rng.normal(size=((img + 2) // 3, (img + 2) // 3))
-    noise = np.kron(coarse, np.ones((3, 3)))[:img, :img]
+    noise = np.kron(coarse, np.ones((3, 3), np.float64))[:img, :img]
     dom = noise < np.quantile(noise, 0.70)
     base = np.where(dom[:, :, None], c_dom[None, None, :],
                     c_sec[None, None, :])
@@ -148,7 +148,7 @@ def texture_hard(cls: int, idx: int, n_classes: int, img: int,
     t_hi = min(0.10, (1.0 - d) / 2.0 - 0.02)
     t = rng.uniform(0.02, t_hi) if n_hues >= 3 else 0.0
     coarse = rng.normal(size=((img + 2) // 3, (img + 2) // 3))
-    noise = np.kron(coarse, np.ones((3, 3)))[:img, :img]
+    noise = np.kron(coarse, np.ones((3, 3), np.float64))[:img, :img]
     q_dom, q_dis = np.quantile(noise, [d, 1.0 - t])
     base = np.where((noise < q_dom)[:, :, None], c_dom[None, None, :],
                     np.where((noise >= q_dis)[:, :, None],

@@ -42,8 +42,7 @@ from imagent_tpu.resilience.watchdog import StepWatchdog
 from imagent_tpu.schedule import lr_for_epoch
 from imagent_tpu.train import (
     TrainState, create_train_state, make_eval_step, make_optimizer,
-    make_train_step, place_state, replicate_state,
-    state_partition_specs,
+    make_train_step, place_state, state_partition_specs,
 )
 from imagent_tpu.utils.logging import TrainLogger
 from imagent_tpu.utils.metrics import AverageMeter
@@ -262,7 +261,15 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                 watchdog.beat()
             if is_master and cfg.log_every \
                     and (step_i + 1) % cfg.log_every == 0:
-                m = np.asarray(metrics)  # syncs a step already in flight
+                # Log from a metric _GUARD_LAG steps behind the dispatch
+                # frontier: that step has (almost always) already
+                # retired, so this is a cheap D2H of ready bytes — not a
+                # drain of the in-flight pipeline, which is what
+                # fetching THIS step's vector would force. The printed
+                # loss therefore lags the step counter by <= _GUARD_LAG
+                # steps (harmless for progress monitoring).
+                m = np.asarray(
+                    metric_buf[max(0, len(metric_buf) - 1 - _GUARD_LAG)])
                 print(f"  epoch {epoch + 1} step {step_i + 1}/"
                       f"{loader.steps_per_epoch} loss "
                       f"{m[0] / max(m[3], 1):.4f} "

@@ -28,7 +28,6 @@ def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
         logits, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
     nll = lse - target_logit
     if label_smoothing > 0.0:
-        n = logits.shape[-1]
         mean_logit = jnp.mean(logits, axis=-1)
         smooth_nll = lse - mean_logit
         nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth_nll

@@ -37,7 +37,7 @@ axis for replicated ones.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Callable
 
 import flax.linen as nn
 import jax

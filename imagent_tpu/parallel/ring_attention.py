@@ -17,7 +17,6 @@ sequence) is asserted in tests on an 8-device mesh.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -33,7 +33,6 @@ semantics"):
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import flax.struct

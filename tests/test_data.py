@@ -146,8 +146,6 @@ def test_transfer_dtype_bf16_batches():
 def test_device_prefetch_matches_direct_sharding():
     """The prefetcher yields the same device arrays, in order, as direct
     shard_batch calls, for both train (2-tuple) and eval (3-tuple)."""
-    import jax
-
     from imagent_tpu.cluster import make_mesh
     from imagent_tpu.config import Config
     from imagent_tpu.data.prefetch import device_prefetch
@@ -216,8 +214,6 @@ def test_early_exit_releases_producer_threads(tmp_path):
     stage (device_prefetch) unwind via GeneratorExit."""
     import threading
     import time as _time
-
-    import jax
 
     from imagent_tpu.cluster import make_mesh
     from imagent_tpu.config import Config

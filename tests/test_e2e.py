@@ -3,7 +3,6 @@ init→shard→step→psum→metrics→log→checkpoint path on 8 fake devices w
 synthetic data — the BASELINE.json "CPU smoke" config, hardware-free."""
 
 import jax
-import numpy as np
 import pytest
 
 from imagent_tpu.config import Config

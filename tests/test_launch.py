@@ -13,7 +13,6 @@ stub that records the fan-out command.
 import os
 import stat
 import subprocess
-import sys
 
 import pytest
 

@@ -162,8 +162,6 @@ def test_pp_ep_composed(data):
     """pp x ep: MoE layers (moe_every=1) inside GPipe stages, experts
     sharded over the model axis — matches the single-stage stacked MoE
     twin run with the same microbatching and capacity grouping."""
-    from imagent_tpu.parallel.expert_parallel import vit_moe_param_specs
-
     images, labels = data
     pp, ep, mb = 2, 2, 2
     moe = dict(moe_every=1, num_experts=4, capacity_factor=2.0,

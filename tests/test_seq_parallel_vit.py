@@ -5,14 +5,13 @@ with full attention — validating the token slicing, ring collectives,
 pmean readout, and the model-axis gradient reduction in one shot."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from imagent_tpu.cluster import MODEL_AXIS, make_mesh
 from imagent_tpu.models.vit import VisionTransformer
 from imagent_tpu.train import (
-    TrainState, create_train_state, make_eval_step, make_optimizer,
+    create_train_state, make_eval_step, make_optimizer,
     make_train_step, replicate_state, shard_batch,
 )
 

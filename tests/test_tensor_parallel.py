@@ -4,7 +4,6 @@ metrics as the unsharded model on the concatenated batch — the TP
 analogue of the DDP-equivalence invariant (SURVEY §4)."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

@@ -38,7 +38,6 @@ def test_zero1_update_bitwise_matches_optax():
     conv-backward algorithms for differently-structured programs, which
     perturbs the *gradients*, not the optimizer.)"""
     import optax
-    from jax import lax
     from jax.sharding import PartitionSpec as P
 
     mesh = make_mesh(model_parallel=1)
