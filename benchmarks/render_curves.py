@@ -5,7 +5,10 @@ The reference ships rendered curves as its README artifact
 framework's analogue straight from the event files the torch-free
 writer (utils/tb_writer.py) emits — loss / top-1 / top-5 (train + val)
 and the LR schedule vs epoch, four small multiples sharing the epoch
-axis (never a dual-axis chart).
+axis (never a dual-axis chart).  When the run carries a
+``telemetry.jsonl`` (imagent_tpu/telemetry), a full-width goodput
+panel rides below: wall-clock seconds per epoch as a stacked area
+over the phase taxonomy — where every second went, at a glance.
 
     python benchmarks/render_curves.py --log-dir runs/<run> \
         --out docs/runs/<run>_curves.png [--title "..."]
@@ -14,7 +17,10 @@ Layout (dataviz method): train/val are categorical slots 1/2 of the
 validated reference palette (blue #2a78d6 / orange #eb6834 — the
 adjacent-pair CVD separation is validated there), 2px lines, recessive
 grid, direct end-labels plus a single legend, text in ink tokens (not
-series colors), light surface.
+series colors), light surface.  The goodput stack keeps the same
+system: useful work in the blue family at the bottom, input-wait in
+the slot-2 orange (the alarm color of the H2D docs), overheads in
+muted distinct hues, residual in gray.
 """
 
 from __future__ import annotations
@@ -23,6 +29,9 @@ import argparse
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root: the telemetry reader
+
 SURFACE = "#fcfcfb"
 INK = "#0b0b0b"
 INK_2 = "#52514e"
@@ -30,12 +39,29 @@ GRID = "#e4e3df"
 TRAIN = "#2a78d6"  # categorical slot 1 (blue)
 VAL = "#eb6834"    # categorical slot 2 (orange)
 
+# Goodput stack: bottom-up draw order — useful step work first (the
+# blue family), then each overhead class in its own hue.
+PHASE_ORDER = ("dispatch", "step_drain", "compile", "input_wait",
+               "eval", "checkpoint", "recovery", "host_other")
+PHASE_COLORS = {
+    "dispatch": "#2a78d6",    # useful: step dispatch (slot-1 blue)
+    "step_drain": "#7fb3e8",  # useful: device drain (lighter blue)
+    "compile": "#8a63d2",     # purple — one-off trace/compile cost
+    "input_wait": "#eb6834",  # slot-2 orange — the starvation alarm
+    "eval": "#2e9e77",        # green
+    "checkpoint": "#d9a514",  # gold
+    "recovery": "#c43d3d",    # red — rollbacks/restores
+    "host_other": "#9b9a97",  # gray residual
+}
+
 
 def read_scalar(log_dir: str, sub: str, tag: str):
     """[(step, value)] from one event subdir, sorted by step."""
     from tensorboard.backend.event_processing import event_accumulator
 
     d = os.path.join(log_dir, sub) if sub else log_dir
+    if not os.path.isdir(d):
+        return []  # run never wrote this series (e.g. no val epochs)
     ea = event_accumulator.EventAccumulator(
         d, size_guidance={event_accumulator.SCALARS: 0})
     ea.Reload()
@@ -43,6 +69,46 @@ def read_scalar(log_dir: str, sub: str, tag: str):
         return []
     ev = ea.Scalars(tag)
     return sorted((e.step, e.value) for e in ev)
+
+
+def read_goodput(log_dir: str):
+    """``(epochs, {phase: [seconds]})`` from the run's telemetry.jsonl
+    (imagent_tpu/telemetry/events.py), or None when the run has no
+    telemetry.  A resumed run appends — the LAST record per epoch
+    wins, matching the reader contract in events.py."""
+    path = os.path.join(log_dir, "telemetry.jsonl")
+    if not os.path.exists(path):
+        return None
+    from imagent_tpu.telemetry.events import read_events
+
+    by_epoch: dict[int, dict] = {}
+    for rec in read_events(path):
+        if rec.get("event") == "epoch" and "phases" in rec:
+            by_epoch[int(rec["epoch"])] = rec["phases"]
+    if not by_epoch:
+        return None
+    epochs = sorted(by_epoch)
+    stacks = {p: [float(by_epoch[e].get(p, 0.0)) for e in epochs]
+              for p in PHASE_ORDER}
+    return epochs, stacks
+
+
+def _draw_goodput(ax, epochs, stacks) -> None:
+    """Stacked area: wall seconds per epoch, partitioned by phase."""
+    ax.set_facecolor(SURFACE)
+    ax.stackplot(epochs, [stacks[p] for p in PHASE_ORDER],
+                 labels=PHASE_ORDER,
+                 colors=[PHASE_COLORS[p] for p in PHASE_ORDER],
+                 linewidth=0)
+    ax.set_ylabel("epoch wall (s)", color=INK, fontsize=10)
+    ax.set_xlabel("epoch", color=INK, fontsize=10)
+    ax.grid(True, color=GRID, linewidth=0.8, axis="y")
+    ax.tick_params(colors=INK_2, labelsize=8)
+    for s in ax.spines.values():
+        s.set_color(GRID)
+    ax.margins(x=0.02)
+    ax.legend(frameon=False, fontsize=7, labelcolor=INK_2, ncol=4,
+              loc="upper right")
 
 
 def render(log_dir: str, out: str, title: str | None = None) -> str:
@@ -59,9 +125,22 @@ def render(log_dir: str, out: str, title: str | None = None) -> str:
          [("Top5_train", "train"), ("Top5_test", "val")]),
         ("Learning rate", "lr", [("", "lr")]),
     ]
-    fig, axes = plt.subplots(2, 2, figsize=(10, 7), dpi=150,
-                             facecolor=SURFACE, sharex=True)
-    for ax, (ylabel, tag, series) in zip(axes.flat, panels):
+    goodput = read_goodput(log_dir)
+    if goodput is None:
+        fig, axes = plt.subplots(2, 2, figsize=(10, 7), dpi=150,
+                                 facecolor=SURFACE, sharex=True)
+        curve_axes = list(axes.flat)
+        bottom_axes = axes[1]
+    else:
+        fig = plt.figure(figsize=(10, 10), dpi=150, facecolor=SURFACE)
+        gs = fig.add_gridspec(3, 2, height_ratios=(1, 1, 0.9))
+        curve_axes = [fig.add_subplot(gs[r, c])
+                      for r in range(2) for c in range(2)]
+        for ax in curve_axes[1:]:
+            ax.sharex(curve_axes[0])
+        bottom_axes = curve_axes[2:]
+        _draw_goodput(fig.add_subplot(gs[2, :]), *goodput)
+    for ax, (ylabel, tag, series) in zip(curve_axes, panels):
         ax.set_facecolor(SURFACE)
         for sub, label in series:
             pts = read_scalar(log_dir, sub, tag)
@@ -81,7 +160,7 @@ def render(log_dir: str, out: str, title: str | None = None) -> str:
         ax.margins(x=0.02)
         if len(series) > 1:
             ax.legend(frameon=False, fontsize=8, labelcolor=INK_2)
-    for ax in axes[1]:
+    for ax in bottom_axes:
         ax.set_xlabel("epoch", color=INK, fontsize=10)
     if title:
         fig.suptitle(title, color=INK, fontsize=12)

@@ -10,7 +10,9 @@ different hosts (different collective layouts → hang or silent
 corruption); a train step jitted without donation doubles the
 parameter+optimizer HBM footprint; an implicit-dtype array on the wire
 path quietly re-inflates the uint8 wire format to float64; a benchmark
-that stops its timer without a device sync measures dispatch, not work.
+that stops its timer without a device sync measures dispatch, not work;
+a TensorBoard tag interpolating a step number mints a fresh series
+every step until the dashboard (and the event file) drowns.
 
 Detection is intra-module and intentionally conservative: a rule fires
 only on patterns it can see whole (see docs/STATIC_ANALYSIS.md for the
@@ -23,6 +25,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import re
 from typing import Callable, Iterator
 
 # --------------------------------------------------------------------------
@@ -710,7 +713,56 @@ def check_dtype_contract(ctx: ModuleContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
-# Rule 7: untimed-block
+# Rule 7: telemetry-tag-format
+# --------------------------------------------------------------------------
+
+_TB_WRITE_METHODS = {"add_scalar", "add_scalars", "add_histogram"}
+# namespace/snake_case: lowercase segments separated by "/", each
+# starting with a letter — what every telemetry series in the repo
+# uses ("goodput/fraction", "steptime/p95_ms", "data/h2d_mb").
+_TAG_RE = re.compile(r"^[a-z][a-z0-9_]*(/[a-z][a-z0-9_]*)*$")
+
+
+@rule("telemetry-tag-format",
+      "TB tags must be namespace/snake_case literals; interpolating "
+      "values (step numbers) into a tag mints unbounded series")
+def check_telemetry_tags(ctx: ModuleContext) -> Iterator[Finding]:
+    """Conservative: only literal and f-string first arguments to the
+    writer methods are judged (a variable tag is invisible here — the
+    call sites that build tags dynamically must keep the family
+    bounded, which is what the suppression justification documents)."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _TB_WRITE_METHODS
+                and node.args):
+            continue
+        tag = node.args[0]
+        if isinstance(tag, ast.JoinedStr):
+            if any(isinstance(v, ast.FormattedValue)
+                   for v in tag.values):
+                yield ctx.finding(
+                    node, "telemetry-tag-format",
+                    f"f-string tag in {node.func.attr}(): every "
+                    "distinct interpolated value mints a NEW "
+                    "TensorBoard series (a step number in the tag = "
+                    "one series per step) — put variables in the "
+                    "step/value arguments, or suppress with the "
+                    "justification that the family is bounded")
+        elif isinstance(tag, ast.Constant) and isinstance(tag.value,
+                                                          str):
+            if not _TAG_RE.match(tag.value):
+                yield ctx.finding(
+                    node, "telemetry-tag-format",
+                    f"tag {tag.value!r} is not namespace/snake_case "
+                    "(^[a-z][a-z0-9_]*(/segment)*$): mixed-case and "
+                    "ad-hoc tags scatter related series across the "
+                    "TB sidebar instead of grouping under one "
+                    "namespace")
+
+
+# --------------------------------------------------------------------------
+# Rule 8: untimed-block
 # --------------------------------------------------------------------------
 
 _TIMER_CALLS = {"time.time", "time.perf_counter", "time.monotonic"}

@@ -583,6 +583,62 @@ def _verified_globally(ckpt_dir: str, cand: str) -> tuple[bool, str]:
     return agreed, detail
 
 
+def _pod_agree(ok: bool) -> bool:
+    """ALL-processes agreement on one per-candidate verdict.
+
+    The fallback walk must advance in lockstep: a restore *exception*
+    on one host (its NFS mount serving torn bytes, a local read error)
+    with success on the others would leave that host on ``last.1``
+    while the rest return ``last`` — a desynchronized pod whose next
+    collective silently trains from mixed states or hangs. Min-reduce
+    over the per-process flags: any failure anywhere fails the
+    candidate everywhere, and every host walks to the same next rung.
+    """
+    if jax.process_count() == 1:
+        return ok
+    from jax.experimental import multihost_utils
+    flags = multihost_utils.process_allgather(
+        np.asarray([1 if ok else 0], np.int32))
+    return bool(np.asarray(flags).min())
+
+
+_CANDIDATE_WIRE_BYTES = 2048
+
+
+def _pod_candidates(ckpt_dir: str, name: str) -> list[str]:
+    """The fallback chain every process walks — process 0's listing,
+    broadcast. ``fallback_candidates`` reads ``os.listdir``, which on
+    per-host storage can disagree across the pod; a divergent chain
+    would interleave the per-candidate collectives differently on
+    different hosts and hang. Process 0 is authoritative (it is also
+    the host that writes rotations); candidates it names that are
+    absent elsewhere fail the existence agreement and are skipped by
+    everyone."""
+    if jax.process_count() == 1:
+        return fallback_candidates(ckpt_dir, name)
+    from jax.experimental import multihost_utils
+    buf = np.zeros(_CANDIDATE_WIRE_BYTES, np.uint8)
+    if jax.process_index() == 0:
+        cands = fallback_candidates(ckpt_dir, name)
+        enc = "\n".join(cands).encode()
+        if len(enc) > _CANDIDATE_WIRE_BYTES:
+            # Never truncate mid-name: a cut "last.37" reads as the
+            # WRONG (older) candidate "last.3". Drop whole tail
+            # entries at the last separator that fits, loudly — an
+            # absurd --keep-last-k can overflow the fixed wire buffer.
+            cut = enc.rfind(b"\n", 0, _CANDIDATE_WIRE_BYTES + 1)
+            enc = enc[:cut] if cut > 0 else b""
+            kept = enc.decode().count("\n") + 1 if enc else 0
+            print(f"WARNING: fallback candidate list exceeds the "
+                  f"{_CANDIDATE_WIRE_BYTES}-byte broadcast buffer; "
+                  f"walking only the newest {kept} of {len(cands)} "
+                  "candidates (lower --keep-last-k)", flush=True)
+        buf[: len(enc)] = np.frombuffer(enc, np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf), np.uint8)
+    joined = out.tobytes().split(b"\x00", 1)[0].decode()
+    return [c for c in joined.split("\n") if c]
+
+
 def restore_resilient(ckpt_dir: str, target: TrainState, name: str = LAST,
                       ) -> tuple[TrainState, dict, str] | None:
     """Restore the newest checkpoint that passes integrity verification,
@@ -590,12 +646,25 @@ def restore_resilient(ckpt_dir: str, target: TrainState, name: str = LAST,
     candidate whose manifest fails or whose Orbax restore throws — a
     kill mid-commit or bit-rot on one directory must cost at most one
     checkpoint interval, never the run. Returns ``(state, meta,
-    candidate_name)`` or None when nothing restorable exists."""
+    candidate_name)`` or None when nothing restorable exists.
+
+    Multi-host: every per-candidate verdict — existence, the process-0
+    hash verdict, the PER-HOST readability probe, and the restore
+    outcome itself (exceptions included) — is pod-agreed before the
+    walk advances, so all hosts restore the SAME candidate or none
+    (``_pod_agree``; drilled by ``tests/mp_worker_restore.py``). The
+    per-host probe (``integrity.probe``, stat-only) runs BEFORE the
+    collective Orbax restore: a host whose local replica is torn must
+    divert the whole pod *in advance* — discovering it via a one-sided
+    exception inside the restore's collectives would hang the peers.
+    The exception allgather after the restore then covers the pod-wide
+    failures (layout/arch mismatch) that raise on every host at once.
+    """
     wait_until_finished()  # a just-written checkpoint must be durable
     errors: list[str] = []
-    for cand in fallback_candidates(ckpt_dir, name):
+    for cand in _pod_candidates(ckpt_dir, name):
         path = os.path.join(ckpt_dir, cand)
-        if not os.path.isdir(path):
+        if not _pod_agree(os.path.isdir(path)):
             continue
         ok, detail = _verified_globally(ckpt_dir, cand)
         if not ok:
@@ -604,15 +673,39 @@ def restore_resilient(ckpt_dir: str, target: TrainState, name: str = LAST,
                   flush=True)
             errors.append(f"{cand}: {detail}")
             continue
+        probe_ok, probe_detail = integrity.probe(ckpt_dir, cand)
+        if not probe_ok:
+            print(f"WARNING: checkpoint {path} failed the local "
+                  f"readability probe on this host ({probe_detail}); "
+                  "the whole pod falls back together", flush=True)
+            errors.append(f"{cand}: {probe_detail}")
+        if not _pod_agree(probe_ok):
+            if probe_ok:
+                print(f"NOTE: checkpoint {path} probes clean on this "
+                      "host but is torn on a peer; advancing to the "
+                      "next fallback on every host (split-brain guard)",
+                      flush=True)
+                errors.append(f"{cand}: torn on a peer process")
+            continue
         try:
             restored = restore(ckpt_dir, cand, target)
+            local_ok = restored is not None
         except Exception as e:
+            restored, local_ok = None, False
             print(f"WARNING: checkpoint {path} failed to restore "
                   f"({type(e).__name__}: {e}); trying the next fallback",
                   flush=True)
             errors.append(f"{cand}: {type(e).__name__}")
-            continue
-        if restored is None:
+        if not _pod_agree(local_ok):
+            if local_ok:
+                # This host's copy restored fine but a peer's threw:
+                # discard the local result and advance WITH the pod —
+                # returning here would split the run between candidates.
+                print(f"NOTE: checkpoint {path} restored on this host "
+                      "but failed on a peer; advancing to the next "
+                      "fallback on every host (split-brain guard)",
+                      flush=True)
+                errors.append(f"{cand}: failed on a peer process")
             continue
         if cand != name:
             print(f"NOTE: restored fallback checkpoint {path} "

@@ -175,6 +175,20 @@ def initialize(backend: str | None = None,
     environ = env if env is not None else os.environ
     senv = parse_slurm_env(environ)
     if senv is not None and senv.world_size > 1:
+        if backend == "cpu" or environ.get("JAX_PLATFORMS",
+                                           "").startswith("cpu"):
+            # Cross-process computations on the CPU backend (the pod
+            # dryruns and mp_* drills) need a CPU collectives
+            # implementation — without gloo every cross-host psum/
+            # allgather dies with "Multiprocess computations aren't
+            # implemented on the CPU backend". Must be set before the
+            # backend initializes; harmless for single-process runs
+            # (guarded by world_size above).
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass  # older/newer jax without the option: leave as-is
         if port is None:
             # Two jobs sharing a login host must not collide on the
             # fixed reference port (MASTER_PORT 29500, imagenet.py:242).

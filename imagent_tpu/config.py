@@ -107,7 +107,25 @@ class Config:
     schedule: str = "step"  # step | cosine
     eval_every: int = 1  # validate every N epochs
     log_every: int = 50  # step-level stdout cadence on process 0
-    profile: bool = False  # opt-in jax.profiler trace (SURVEY §5 tracing)
+    # Whole-run jax.profiler trace (SURVEY §5 tracing). Prefer
+    # --profile-at-step: a full-run trace of a long job is unloadably
+    # large and mostly steady-state repetition.
+    profile: bool = False
+    # ---- telemetry (imagent_tpu/telemetry/) ----
+    # Goodput accounting + step-time percentiles + pod aggregation,
+    # written as TB scalars and runs/<run>/telemetry.jsonl. On by
+    # default: the per-step cost is two host timestamps (no device
+    # syncs); --no-telemetry is the kill switch.
+    telemetry: bool = True
+    # Capture a jax.profiler trace for M global steps starting at step
+    # N ("N" or "N:M", M defaults to 10). Resume-aware: global step =
+    # epoch * steps_per_epoch + step. Mutually exclusive with
+    # --profile.
+    profile_at_step: str = ""
+    # A host is flagged as a straggler when its per-epoch input-wait or
+    # step-time p95 exceeds this multiple of the pod median (see
+    # telemetry/aggregate.py for the absolute floors).
+    straggler_factor: float = 2.0
     # Persistent XLA compilation cache dir ("" = off): restarted/resumed
     # runs skip the first-step compile (~minutes for big models).
     compile_cache: str = ""
@@ -290,7 +308,22 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["step", "cosine"])
     p.add_argument("--eval-every", type=int, default=c.eval_every)
     p.add_argument("--log-every", type=int, default=c.log_every)
-    p.add_argument("--profile", action="store_true", default=False)
+    p.add_argument("--profile", action="store_true", default=False,
+                   help="whole-run jax.profiler trace into --log-dir "
+                        "(prefer --profile-at-step for long runs)")
+    p.add_argument("--profile-at-step", type=str,
+                   default=c.profile_at_step, metavar="N[:M]",
+                   help="capture a jax.profiler trace for M steps "
+                        "(default 10) starting at global step N — "
+                        "mid-run and resume-aware, unlike --profile")
+    p.add_argument("--no-telemetry", dest="telemetry",
+                   action="store_false", default=True,
+                   help="disable goodput/step-time/straggler telemetry "
+                        "(TB scalars + telemetry.jsonl)")
+    p.add_argument("--straggler-factor", type=float,
+                   default=c.straggler_factor,
+                   help="flag a host whose input-wait or step p95 "
+                        "exceeds this multiple of the pod median")
     p.add_argument("--compile-cache", type=str, default=c.compile_cache,
                    help="persistent XLA compilation cache directory")
     p.add_argument("--check-nans", action="store_true", default=False)

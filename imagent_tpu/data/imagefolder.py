@@ -218,6 +218,14 @@ class ImageFolderLoader:
         self._warned_bad: set[str] = set()
         self._quarantined = 0  # unreadable files zero-filled this epoch
 
+    @property
+    def quarantined(self) -> int:
+        """Unreadable samples zero-filled during the most recent epoch
+        (reset at each ``epoch()`` start) — absorbed into the per-epoch
+        telemetry counters and the pod straggler aggregation (a host
+        whose shard rots quarantines more AND decodes slower)."""
+        return self._quarantined
+
     def _ensure_pool(self):
         if self._use_native is None:
             if self.cfg.native_io:

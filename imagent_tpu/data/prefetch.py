@@ -41,18 +41,23 @@ class PrefetchStats:
     ``wait_s``: consumer time blocked in the staging queue's ``get`` —
     host-blocked time the step loop spent starving for input. ``~0``
     means compute-bound; approaching the epoch walltime means the
-    decode/H2D pipeline is the bottleneck. ``bytes_staged``: host bytes
-    handed to ``shard_batch`` for the host→device transfer (the wire
-    bytes the ``--transfer-dtype`` knob shrinks). ``batches``: staged
-    batch count."""
+    decode/H2D pipeline is the bottleneck. ``max_wait_s``: the worst
+    single queue wait — a large max on a small total means bursty
+    stalls (cold page cache, networked-storage hiccups: raise
+    ``--prefetch-depth``), while total ≈ steps × max means the decode
+    side is uniformly too slow (raise ``--workers``). ``bytes_staged``:
+    host bytes handed to ``shard_batch`` for the host→device transfer
+    (the wire bytes the ``--transfer-dtype`` knob shrinks).
+    ``batches``: staged batch count."""
 
-    __slots__ = ("wait_s", "bytes_staged", "batches")
+    __slots__ = ("wait_s", "max_wait_s", "bytes_staged", "batches")
 
     def __init__(self):
         self.reset()
 
     def reset(self) -> None:
         self.wait_s = 0.0
+        self.max_wait_s = 0.0
         self.bytes_staged = 0
         self.batches = 0
 
@@ -103,7 +108,10 @@ def iter_with_producer(produce: Callable, maxsize: int,
             else:
                 t0 = time.perf_counter()
                 item = q.get()
-                stats.wait_s += time.perf_counter() - t0
+                waited = time.perf_counter() - t0
+                stats.wait_s += waited
+                if waited > stats.max_wait_s:
+                    stats.max_wait_s = waited
             if item is _END:
                 break
             if isinstance(item, BaseException):

@@ -40,6 +40,7 @@ from imagent_tpu.models import create_model
 from imagent_tpu.resilience import faultinject
 from imagent_tpu.resilience.watchdog import StepWatchdog
 from imagent_tpu.schedule import lr_for_epoch
+from imagent_tpu.telemetry import TelemetrySession, parse_profile_at_step
 from imagent_tpu.train import (
     TrainState, create_train_state, make_eval_step, make_optimizer,
     make_train_step, place_state, state_partition_specs,
@@ -164,6 +165,7 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                     loader, epoch: int, lr: float, is_master: bool,
                     stop_check=None, start_step: int = 0,
                     watchdog: StepWatchdog | None = None,
+                    telem: TelemetrySession | None = None,
                     ) -> tuple[TrainState, dict, float, int, bool]:
     """One training epoch (reference ``train()``, ``imagenet.py:97-151``).
 
@@ -185,12 +187,27 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
     — step dispatch stays async. The verdicts are replicated arrays, so
     every host counts the same sequence and agrees on the rollback
     decision without any extra collective.
+
+    ``telem`` (telemetry.TelemetrySession): per-step instrumentation is
+    two host timestamps around the dispatch (goodput attribution +
+    step-cadence sampling) plus an int comparison for the profiler
+    window — the same zero-device-sync discipline as the guard above.
     """
     t0 = time.time()
     data_time = AverageMeter("data")
     stats = PrefetchStats()
     metric_buf = []
-    lr_arr = np.float32(lr)
+    # Place the epoch's LR on the mesh ONCE, not per step: an
+    # uncommitted numpy scalar handed to the jitted step is device_put
+    # onto the replicated sharding at EVERY dispatch, and on multi-host
+    # that placement runs an assert_equal broadcast collective — a
+    # per-step host round-trip racing the in-flight step psums (gloo
+    # aborts on the reorder; TPU just serializes). The local-data path
+    # (every host computes the same lr_for_epoch) makes the placement
+    # itself collective-free too, same as replicate_state.
+    lr_arr = jax.make_array_from_process_local_data(
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+        np.asarray(lr, np.float32))
     interrupted_at = -1
     steps_done = start_step
     max_bad = max(cfg.max_bad_steps, 0)
@@ -249,7 +266,16 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                     images = images * jnp.float32(np.nan)
                 if faultinject.fire("sigterm") is not None:
                     os.kill(os.getpid(), signal.SIGTERM)
+            if telem is not None:
+                telem.profile_step(
+                    epoch * loader.steps_per_epoch + step_i)
+                t_dispatch = time.perf_counter()
             state, metrics = train_step(state, images, labels, lr_arr)
+            if telem is not None:
+                # Dispatch is async: this duration is µs on a steady
+                # step and seconds on a compiling one — the accountant
+                # splits compile from dispatch on that gap.
+                telem.record_dispatch(time.perf_counter() - t_dispatch)
             metric_buf.append(metrics)
             steps_done += 1
             if max_bad:
@@ -281,7 +307,15 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
     finally:
         if watchdog is not None:
             watchdog.disarm()
+    t_drain = time.perf_counter()
     epoch_metrics = _finalize(metric_buf)  # the only mandatory sync point
+    if telem is not None:
+        # The finalize sync is the device draining the dispatched step
+        # frontier — the device-side tail of useful training work.
+        telem.phase("step_drain", time.perf_counter() - t_drain)
+        telem.absorb_input(stats)
+        telem.count("quarantined",
+                    int(getattr(loader, "quarantined", 0) or 0))
     # Data-starvation counters (data/prefetch.py::PrefetchStats): how
     # long the step loop sat blocked on the staging queue, and the wire
     # bytes that crossed host→device — input-boundness diagnosable from
@@ -292,7 +326,8 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
 
 
 def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
-             epoch: int) -> tuple[dict, float]:
+             epoch: int, telem: TelemetrySession | None = None,
+             ) -> tuple[dict, float]:
     """Validation epoch (reference ``validate()``, ``imagenet.py:166-210``),
     exact under padding via the mask. With --ema-decay the evaluated
     weights are the EMA (``model.eval()`` on the averaged model) AND so
@@ -315,6 +350,11 @@ def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
     metrics = _finalize(metric_buf)
     metrics["host_blocked_s"] = round(stats.wait_s, 3)
     metrics["h2d_bytes"] = int(stats.bytes_staged)
+    if telem is not None:
+        # The eval epoch is one `eval` phase to the goodput accountant
+        # (attributed by the caller); its internal input-wait rides the
+        # counters so an input-bound VAL path is still visible.
+        telem.count("eval_input_wait_s", stats.wait_s)
     return metrics, time.time() - t0
 
 
@@ -475,6 +515,14 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
             f"got {cfg.transfer_dtype!r}")
     if cfg.prefetch_depth < 1:
         raise ValueError("--prefetch-depth must be >= 1")
+    if cfg.profile and cfg.profile_at_step:
+        raise ValueError("--profile and --profile-at-step are mutually "
+                         "exclusive: both drive jax.profiler traces "
+                         "(prefer the windowed --profile-at-step)")
+    parse_profile_at_step(cfg.profile_at_step)  # fail before pod time
+    if cfg.straggler_factor < 0:
+        raise ValueError("--straggler-factor must be >= 0 "
+                         "(0 disables flagging)")
     use_sp = cfg.seq_parallel != "none"
     if use_sp and (not cfg.arch.startswith("vit") or cfg.model_parallel < 2):
         raise ValueError(
@@ -824,15 +872,39 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
                 "final_train": train_m, "final_val": val_m,
                 "preempted": False, "rollbacks": 0}
 
+    # Telemetry (imagent_tpu/telemetry): goodput phases, step-time
+    # percentiles, pod aggregation + straggler flags — TB scalars and
+    # the telemetry.jsonl event log. Its one collective (the per-host
+    # counter allgather) runs inside epoch_end, which every epoch-exit
+    # path below reaches on every process (the exits are pod-agreed
+    # decisions: rollback verdicts ride replicated metric vectors, the
+    # preemption stop is any-reduced).
+    telem = TelemetrySession(cfg, is_master, logger)
+    telem.run_start({
+        "arch": cfg.arch, "global_batch": global_batch,
+        "process_count": jax.process_count(),
+        "device_count": jax.device_count(),
+        "steps_per_epoch": train_loader.steps_per_epoch,
+        "start_epoch": start_epoch, "resume_step": resume_step,
+        "seed": cfg.seed,
+    })
+
+    def _end_telemetry_epoch(ep: int, tm: dict,
+                             interrupted: bool = False) -> None:
+        if watchdog is not None and watchdog.fired:
+            telem.count("watchdog_fired")
+        telem.epoch_end(ep, tm, interrupted=interrupted)
+
     rollbacks = 0        # total, reported in the summary
     rollback_streak = 0  # consecutive incidents — the give-up budget
     epoch = start_epoch
     while epoch < cfg.epochs:
         lr = lr_for_epoch(cfg, epoch)
+        telem.epoch_begin()
         state, train_m, train_t, interrupted_at, want_rollback = \
             train_one_epoch(
                 cfg, mesh, train_step, state, train_loader, epoch, lr,
-                is_master, stop_check, resume_step, watchdog)
+                is_master, stop_check, resume_step, watchdog, telem)
         resume_step = 0  # only the first resumed epoch skips batches
         if not want_rollback:
             # An epoch got through without tripping the guard: any
@@ -851,11 +923,13 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
             # checkpoint interval instead of the run.
             rollbacks += 1
             rollback_streak += 1
+            telem.count("rollbacks")
             if rollback_streak > _MAX_ROLLBACKS:
                 raise RuntimeError(
                     f"non-finite steps persisted through {_MAX_ROLLBACKS} "
                     "consecutive rollbacks — giving up (check data / lr "
                     "/ bf16 ranges; the fault reproduces on every replay)")
+            t_rec = time.perf_counter()
             restored = ckpt_lib.restore_resilient(cfg.ckpt_dir, state)
             if restored is None:
                 # Nothing to roll back to — but the in-graph guard
@@ -874,10 +948,16 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
                           f"this epoch ({rollback_streak}/"
                           f"{_MAX_ROLLBACKS} consecutive strikes "
                           "before giving up)", flush=True)
+                telem.phase("recovery", time.perf_counter() - t_rec)
+                _end_telemetry_epoch(epoch, train_m)
                 epoch += 1
                 continue
             state, meta, src = restored
             state = place_state(state, mesh, state_specs)
+            telem.phase("recovery", time.perf_counter() - t_rec)
+            # The record names the epoch that FAILED (the one whose
+            # wall time this was), not the replay target below.
+            _end_telemetry_epoch(epoch, train_m)
             (epoch, resume_step, best_top1, best_top5,
              best_epoch) = _resume_point(meta)
             if is_master:
@@ -891,11 +971,15 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
             # Preemption: persist the mid-epoch state, recording how many
             # of this epoch's steps it contains — --resume skips exactly
             # those batches, so no gradient is applied twice.
+            t_ck = time.perf_counter()
             ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.LAST, state, {
                 "epoch": epoch - 1, "resume_step": interrupted_at,
                 "best_top1": best_top1, "best_top5": best_top5,
                 "best_epoch": best_epoch, **topo_meta},
                 keep_last_k=cfg.keep_last_k)
+            telem.phase("checkpoint", time.perf_counter() - t_ck)
+            telem.count("preempted")
+            _end_telemetry_epoch(epoch, train_m, interrupted=True)
             if is_master:
                 print(f"preemption signal: checkpointed epoch {epoch + 1} "
                       f"at step {interrupted_at}; exiting cleanly "
@@ -905,9 +989,11 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
         did_eval = (epoch + 1) % cfg.eval_every == 0 or epoch == cfg.epochs - 1
         if did_eval:
             val_m, val_t = evaluate(cfg, mesh, eval_step, state,
-                                    val_loader, epoch)
+                                    val_loader, epoch, telem)
+            telem.phase("eval", val_t)
         else:
             val_t = 0.0
+        t_ck = time.perf_counter()
         if did_eval and val_m["top1"] > best_top1:
             best_top1, best_top5, best_epoch = (
                 val_m["top1"], val_m["top5"], epoch)
@@ -922,12 +1008,17 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
                 "epoch": epoch, "best_top1": best_top1,
                 "best_top5": best_top5, "best_epoch": best_epoch,
                 **topo_meta}, block=False, keep_last_k=cfg.keep_last_k)
+        # The blocking slice only: staging for the async LAST (its
+        # finalize overlaps the next epoch by design) plus any BEST
+        # save — the wall time checkpointing actually cost this epoch.
+        telem.phase("checkpoint", time.perf_counter() - t_ck)
         if is_master and train_m.get("bad_steps"):
             print(f"  epoch {epoch + 1}: {train_m['bad_steps']} "
                   "non-finite step(s) skipped", flush=True)
         logger.epoch_summary(epoch, lr, train_m,
                              val_m if did_eval else None, train_t, val_t)
         logger.scalars(epoch, lr, train_m, val_m if did_eval else None)
+        _end_telemetry_epoch(epoch, train_m)
         epoch += 1
 
     ckpt_lib.wait_until_finished()  # land any in-flight async save
@@ -940,8 +1031,12 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
         _export_torch(cfg, state, is_master)
     total_min = (time.time() - run_t0) / 60.0
     logger.final_summary(best_epoch, best_top1, best_top5, total_min)
+    summary = {"best_top1": best_top1, "best_top5": best_top5,
+               "best_epoch": best_epoch, "total_minutes": total_min,
+               "final_train": train_m, "final_val": val_m,
+               "preempted": preempted, "rollbacks": rollbacks}
+    telem.run_end({"best_top1": best_top1, "best_epoch": best_epoch,
+                   "total_minutes": round(total_min, 3),
+                   "preempted": preempted, "rollbacks": rollbacks})
     logger.close()
-    return {"best_top1": best_top1, "best_top5": best_top5,
-            "best_epoch": best_epoch, "total_minutes": total_min,
-            "final_train": train_m, "final_val": val_m,
-            "preempted": preempted, "rollbacks": rollbacks}
+    return summary

@@ -1,0 +1,195 @@
+"""Telemetry subsystem: goodput accounting, step-time percentiles,
+pod-wide straggler detection, profiler windows.
+
+The reference trainer's observability was four per-epoch TensorBoard
+scalars; this package answers the operator questions those cannot:
+*where did the wall-clock go* (``goodput``), *what does the step-time
+distribution look like* (``sampler``), *which host is dragging the pod*
+(``aggregate``), and *what exactly happened at step N*
+(``profiler``) — with every answer queryable after the run from the
+schema-versioned ``telemetry.jsonl`` event log (``events``).
+
+``TelemetrySession`` is the engine-facing facade.  Contract with the
+engine's host-sync discipline (``engine._GUARD_LAG``): the per-step
+surface — ``record_dispatch`` and ``profile_step`` — is pure host
+arithmetic (the hot modules ``goodput``/``sampler`` never import jax);
+the one collective (per-host counter allgather) and all I/O happen in
+``epoch_end``, once per epoch, on pod-agreed paths.
+"""
+
+from __future__ import annotations
+
+from imagent_tpu.telemetry.aggregate import (
+    HOST_FIELDS, allgather_host_stats, flag_stragglers, summarize_hosts,
+)
+from imagent_tpu.telemetry.events import (
+    SCHEMA_VERSION, TelemetryWriter, read_events,
+)
+from imagent_tpu.telemetry.goodput import PHASES, GoodputAccountant
+from imagent_tpu.telemetry.profiler import (
+    ProfilerSession, hbm_stats, parse_profile_at_step,
+)
+from imagent_tpu.telemetry.sampler import StepTimeSampler
+
+__all__ = [
+    "PHASES", "HOST_FIELDS", "SCHEMA_VERSION", "GoodputAccountant",
+    "StepTimeSampler", "TelemetryWriter", "TelemetrySession",
+    "ProfilerSession", "allgather_host_stats", "flag_stragglers",
+    "summarize_hosts", "hbm_stats", "parse_profile_at_step",
+    "read_events",
+]
+
+
+class TelemetrySession:
+    """One training run's telemetry state, driven by the engine.
+
+    Per-epoch lifecycle: ``epoch_begin`` → (steps: ``record_dispatch``
+    / ``profile_step``) → ``absorb_input`` → run-loop ``phase``/
+    ``count`` attributions → ``epoch_end`` (the only collective).
+    ``epoch_end`` must be reached by every process on every epoch-exit
+    path — normal, rollback, preemption — all of which the engine
+    decides pod-globally, so the allgather never splits.
+
+    ``enabled=False`` (``--no-telemetry``) turns every method into a
+    no-op INCLUDING the allgather — consistent across the pod because
+    the flag comes from the shared config.
+    """
+
+    def __init__(self, cfg, is_master: bool, logger=None):
+        self.enabled = bool(getattr(cfg, "telemetry", True))
+        self.is_master = bool(is_master)
+        self.logger = logger
+        self.straggler_factor = float(
+            getattr(cfg, "straggler_factor", 2.0))
+        self.acct = GoodputAccountant()
+        self.sampler = StepTimeSampler()
+        self.writer = (TelemetryWriter(cfg.log_dir)
+                       if self.enabled and self.is_master else None)
+        # Profiler windows ride the session but answer to their own
+        # flag: --profile-at-step works under --no-telemetry too (the
+        # trace is its own artifact; only the jsonl note is lost).
+        self.profiler = ProfilerSession(
+            parse_profile_at_step(getattr(cfg, "profile_at_step", "")),
+            cfg.log_dir, is_master)
+        self.counters: dict[str, float] = {}
+        self._h2d_bytes = 0.0
+        self._max_wait_s = 0.0
+        self._in_epoch = False
+
+    # ---- run lifecycle --------------------------------------------------
+
+    def run_start(self, info: dict) -> None:
+        if self.writer is not None:
+            self.writer.write("run_start", info)
+
+    def run_end(self, summary: dict) -> None:
+        ev = self.profiler.close()
+        if self.writer is not None:
+            if ev is not None:
+                self.writer.write("profile", {"action": ev,
+                                              "reason": "run_end"})
+            self.writer.write("run_end", summary)
+            self.writer.close()
+
+    # ---- epoch lifecycle ------------------------------------------------
+
+    def epoch_begin(self) -> None:
+        if not self.enabled:
+            return
+        self.acct.begin_epoch()
+        self.sampler.epoch_reset()
+        self.counters = {}
+        self._h2d_bytes = 0.0
+        self._max_wait_s = 0.0
+        self._in_epoch = True
+
+    def phase(self, name: str, seconds: float) -> None:
+        """Attribute ``seconds`` of the current epoch to a phase."""
+        if self.enabled and self._in_epoch:
+            self.acct.add(name, seconds)
+
+    def count(self, name: str, inc: float = 1) -> None:
+        if self.enabled and self._in_epoch:
+            self.counters[name] = self.counters.get(name, 0) + inc
+
+    # ---- per-step surface (host arithmetic only — no jax) ---------------
+
+    def record_dispatch(self, seconds: float) -> None:
+        """One train-step dispatch returned after ``seconds``."""
+        if self.enabled and self._in_epoch:
+            self.acct.add_dispatch(seconds)
+            self.sampler.mark()
+
+    def profile_step(self, global_step: int) -> None:
+        """Drive the profiler window; called before each dispatch."""
+        ev = self.profiler.on_step(global_step)
+        if ev is not None and self.writer is not None:
+            self.writer.write("profile", {
+                "action": ev, "global_step": int(global_step),
+                "window": {"start": self.profiler.window.start,
+                           "steps": self.profiler.window.steps}})
+
+    def absorb_input(self, stats) -> None:
+        """Fold a ``PrefetchStats`` into the epoch (train loop only)."""
+        if self.enabled and self._in_epoch:
+            self.acct.add("input_wait", stats.wait_s)
+            self._h2d_bytes += float(stats.bytes_staged)
+            self._max_wait_s = max(self._max_wait_s,
+                                   getattr(stats, "max_wait_s", 0.0))
+
+    # ---- epoch close (the one collective) -------------------------------
+
+    def epoch_end(self, epoch: int, train_m: dict | None = None,
+                  interrupted: bool = False) -> dict | None:
+        if not (self.enabled and self._in_epoch):
+            return None
+        self._in_epoch = False
+        if train_m and train_m.get("bad_steps"):
+            self.counters["bad_steps"] = \
+                self.counters.get("bad_steps", 0) \
+                + int(train_m["bad_steps"])
+        wall, phases, goodput = self.acct.finish()
+        pcts = self.sampler.percentiles()
+        local = {
+            "input_wait_s": phases["input_wait"],
+            "max_wait_s": self._max_wait_s,
+            "dispatch_s": phases["dispatch"],
+            "compile_s": phases["compile"],
+            "step_p50_ms": pcts["p50_ms"],
+            "step_p95_ms": pcts["p95_ms"],
+            "step_p99_ms": pcts["p99_ms"],
+            "h2d_mb": self._h2d_bytes / 1e6,
+            "quarantined": self.counters.get("quarantined", 0),
+        }
+        matrix = allgather_host_stats(local)  # collective (per epoch)
+        record = {
+            "epoch": int(epoch),
+            "wall_s": round(wall, 3),
+            "goodput": round(goodput, 4),
+            "phases": {k: round(v, 3) for k, v in phases.items()},
+            "step_ms": {k: round(v, 3) if isinstance(v, float) else v
+                        for k, v in pcts.items()},
+            "hosts": {"count": int(matrix.shape[0]),
+                      "stats": summarize_hosts(matrix)},
+            "stragglers": flag_stragglers(matrix,
+                                          self.straggler_factor),
+            "counters": {k: round(float(v), 3)
+                         for k, v in sorted(self.counters.items())},
+            "hbm": hbm_stats(),
+            "interrupted": bool(interrupted),
+        }
+        if self.is_master:
+            if record["stragglers"]:
+                names = ", ".join(
+                    f"host {s['host']} {s['metric']} {s['value']} "
+                    f"(pod median {s['median']})"
+                    for s in record["stragglers"])
+                print(f"STRAGGLER: {names} — exceeds "
+                      f"{self.straggler_factor}x the pod median",
+                      flush=True)
+            if self.writer is not None:
+                self.writer.write("epoch", record)
+            if self.logger is not None:
+                self.logger.telemetry(epoch, record,
+                                      self.sampler.intervals_ms())
+        return record

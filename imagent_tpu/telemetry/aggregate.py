@@ -1,0 +1,103 @@
+"""Pod-wide aggregation: per-host counters allgathered once per epoch.
+
+Each host owns a private view of the epoch — its own input-wait, its
+own step cadence, its own decode quarantines.  A pod-scale run goes as
+fast as its slowest host, so the views must meet: once per epoch every
+process contributes a fixed ``HOST_FIELDS`` vector to a single
+``process_allgather`` (one collective per epoch — nothing per step),
+and process 0 logs per-host min/mean/max plus straggler flags.
+
+Straggler rule: a host is flagged on a metric when its value exceeds
+``factor ×`` the pod *median* (median, not mean — one straggler must
+not drag the reference point toward itself) AND an absolute floor (a
+2 ms p95 on a 1 ms median is noise, not a straggler).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# One slot per host counter; ORDER IS THE WIRE FORMAT of the per-epoch
+# allgather — append only (every process must pack identically).
+HOST_FIELDS = (
+    "input_wait_s",   # step loop blocked on the staging queue
+    "max_wait_s",     # worst single queue wait (burstiness)
+    "dispatch_s",     # host time inside step dispatches (non-compile)
+    "compile_s",      # host time inside compiling dispatches
+    "step_p50_ms",    # dispatch-to-dispatch cadence percentiles
+    "step_p95_ms",
+    "step_p99_ms",
+    "h2d_mb",         # host→device wire megabytes staged
+    "quarantined",    # undecodable inputs zero-filled this epoch
+)
+
+# Metrics the straggler rule inspects, with their absolute floors: a
+# host below the floor is never flagged however small the pod median.
+STRAGGLER_FIELDS = {"input_wait_s": 0.5, "step_p95_ms": 10.0}
+
+
+def pack_host_vector(local: dict) -> np.ndarray:
+    """``HOST_FIELDS``-ordered float64 vector (missing keys → 0)."""
+    return np.array([float(local.get(f, 0.0)) for f in HOST_FIELDS],
+                    np.float64)
+
+
+def allgather_host_stats(local: dict) -> np.ndarray:
+    """``[n_hosts, len(HOST_FIELDS)]`` matrix, one row per process.
+
+    Collective: EVERY process must call this at the same point once per
+    epoch (the engine calls it from ``TelemetrySession.epoch_end`` on
+    every epoch-exit path — normal, rollback, preemption — all of which
+    are pod-agreed decisions).  Single-process: no collective at all.
+
+    Ordering note: ``process_allgather`` executes as a device program,
+    so on a pod it must not race other host-issued collectives from
+    OTHER threads. The engine calls it only after the epoch's step
+    frontier is drained (``_finalize``); the one known offender is
+    orbax's async-save background barrier on the CPU/gloo test
+    backend, where gloo aborts on cross-thread reorder — TPU streams
+    serialize the same overlap harmlessly.
+    """
+    vec = pack_host_vector(local)
+    import jax
+    if jax.process_count() == 1:
+        return vec[None, :]
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(vec),
+                      np.float64).reshape(jax.process_count(),
+                                          len(HOST_FIELDS))
+
+
+def summarize_hosts(matrix: np.ndarray) -> dict:
+    """Per-field ``{min, mean, max}`` over hosts (plain floats)."""
+    out = {}
+    for j, field in enumerate(HOST_FIELDS):
+        col = matrix[:, j]
+        out[field] = {"min": float(col.min()),
+                      "mean": float(col.mean()),
+                      "max": float(col.max())}
+    return out
+
+
+def flag_stragglers(matrix: np.ndarray, factor: float,
+                    floors: dict | None = None) -> list[dict]:
+    """Hosts whose input-wait or step p95 exceeds the pod median by
+    ``factor`` (and the metric's absolute floor).  Returns
+    ``[{host, metric, value, median}]`` sorted by host then metric —
+    deterministic, so the JSONL record is stable across runs."""
+    floors = STRAGGLER_FIELDS if floors is None else floors
+    if factor <= 0 or matrix.shape[0] < 2:
+        return []  # a one-host pod has no peers to straggle behind
+    flags = []
+    for field, floor in sorted(floors.items()):
+        j = HOST_FIELDS.index(field)
+        col = matrix[:, j]
+        med = float(np.median(col))
+        for host in range(matrix.shape[0]):
+            v = float(col[host])
+            if v > max(factor * med, floor):
+                flags.append({"host": host, "metric": field,
+                              "value": round(v, 3),
+                              "median": round(med, 3)})
+    flags.sort(key=lambda f: (f["host"], f["metric"]))
+    return flags

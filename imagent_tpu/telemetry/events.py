@@ -1,0 +1,92 @@
+"""Structured telemetry event log: ``<log_dir>/telemetry.jsonl``.
+
+TensorBoard scalars answer "show me the curve"; they cannot answer
+"where did the wall-clock go on run X" from a script.  This log can:
+one JSON object per line, schema-versioned, append-only (a resumed run
+appends — the reader keeps the LAST record per epoch), written by
+process 0 only.
+
+Event types:
+
+* ``run_start``  — topology + config fingerprint (arch, global batch,
+  process count, device count).
+* ``epoch``      — the per-epoch record: wall, goodput phases
+  (``goodput.PHASES``), step-time percentiles, pod-aggregated per-host
+  stats, straggler flags, resilience counters, HBM stats.
+* ``profile``    — a ``--profile-at-step`` window opened/closed.
+* ``run_end``    — run summary totals.
+
+Every record carries ``{"event": <type>, "schema": SCHEMA_VERSION,
+"t": <unix seconds>}``.  Consumers must ignore unknown keys and check
+``schema`` (bumped only for incompatible changes — additions are not
+bumps).  ``benchmarks/render_curves.py`` is the reference reader.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+SCHEMA_VERSION = 1
+FILENAME = "telemetry.jsonl"
+
+
+def _jsonable(obj):
+    """Plain-Python mirror of ``obj`` (numpy scalars/arrays → Python),
+    so ``json.dumps`` never trips on a stray np.float64."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    item = getattr(obj, "item", None)
+    if item is not None and getattr(obj, "shape", None) == ():
+        return item()  # numpy scalar
+    tolist = getattr(obj, "tolist", None)
+    if tolist is not None:
+        return _jsonable(tolist())  # numpy array
+    return obj
+
+
+class TelemetryWriter:
+    """Append-only JSONL writer (open lazily, line-buffered flushes)."""
+
+    def __init__(self, log_dir: str):
+        self.path = os.path.join(log_dir, FILENAME)
+        self._f = None
+
+    def write(self, event: str, payload: dict) -> dict:
+        """Append one record; returns the full record written."""
+        record = {"event": event, "schema": SCHEMA_VERSION,
+                  "t": round(time.time(), 3)}
+        record.update(_jsonable(payload))
+        if self._f is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._f = open(self.path, "a", encoding="utf-8")
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()  # a killed run keeps every completed epoch
+        return record
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse a telemetry.jsonl; skips lines whose schema is newer than
+    this reader understands (and blank/torn trailing lines)."""
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail from a killed run
+            if isinstance(rec, dict) and \
+                    rec.get("schema", 0) <= SCHEMA_VERSION:
+                out.append(rec)
+    return out

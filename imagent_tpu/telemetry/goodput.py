@@ -1,0 +1,101 @@
+"""Goodput accounting: partition epoch wall time into named phases.
+
+Of every wall-clock second an epoch spends, how many bought training
+progress?  The accountant answers that without a profiler trace: the
+engine attributes measured host durations to a fixed phase taxonomy
+and the residual (Python overhead the engine does not bracket — stop
+polls, logging, loop bookkeeping) lands in ``host_other``, so the
+phases always sum to the measured wall time exactly.
+
+Phase taxonomy (``PHASES``):
+
+* ``compile``    — step dispatches that blocked on trace+compile (the
+  first step of a geometry, and any retrace).  Classified by the
+  dispatch-duration threshold: an async dispatch returns in
+  microseconds, a compiling one blocks for seconds — there is nothing
+  in between on a steady pipeline.
+* ``dispatch``   — non-compiling step dispatches (host side of useful
+  training work; the device computes under them).
+* ``step_drain`` — the epoch-end metric sync (``engine._finalize``):
+  the host waiting for the device to retire the dispatched frontier —
+  the device-side tail of useful training work.
+* ``input_wait`` — step loop blocked on the staging queue
+  (``data/prefetch.py::PrefetchStats.wait_s``).
+* ``eval``       — validation epochs.
+* ``checkpoint`` — blocking portion of checkpoint saves (staging; the
+  async finalize overlaps training and is deliberately not charged).
+* ``recovery``   — resilience events: rollback restores, fallback
+  walks.
+* ``host_other`` — the residual (never negative).
+
+``goodput`` = (compile-free step work) / wall =
+``(dispatch + step_drain) / wall`` — the fraction of the epoch that
+bought optimizer progress.
+
+This module is imported per training step (via ``TelemetrySession``)
+and therefore must stay jax-free: pure host arithmetic on floats, no
+device syncs (tested by ``tests/test_telemetry.py``).
+"""
+
+from __future__ import annotations
+
+import time
+
+PHASES = ("compile", "dispatch", "step_drain", "input_wait", "eval",
+          "checkpoint", "recovery", "host_other")
+
+# A step dispatch is asynchronous (microseconds); one that blocks this
+# long was compiling/retracing.  Conservative: a genuinely slow host
+# misattributing one dispatch to `compile` costs nothing downstream.
+# Known caveat: the CPU backend sometimes executes small programs
+# synchronously inside dispatch, so CPU smoke runs over-attribute
+# steady steps to `compile` — on TPU (the platform this accounts for)
+# the µs-vs-seconds gap is unambiguous, and either way the phases
+# still sum to the measured wall.
+COMPILE_THRESHOLD_S = 0.5
+
+
+class GoodputAccountant:
+    """Per-epoch phase accumulator with an injectable clock (tests)."""
+
+    def __init__(self, compile_threshold_s: float = COMPILE_THRESHOLD_S):
+        self.compile_threshold_s = float(compile_threshold_s)
+        self._acc: dict[str, float] = {}
+        self._t0: float | None = None
+
+    def begin_epoch(self, now: float | None = None) -> None:
+        self._acc = {p: 0.0 for p in PHASES}
+        self._t0 = time.perf_counter() if now is None else now
+
+    def add(self, phase: str, seconds: float) -> None:
+        if phase not in self._acc:
+            raise ValueError(f"unknown phase {phase!r} (taxonomy: "
+                             f"{', '.join(PHASES)})")
+        self._acc[phase] += float(seconds)
+
+    def add_dispatch(self, seconds: float) -> str:
+        """Attribute one step dispatch; returns the phase it landed in."""
+        phase = ("compile" if seconds >= self.compile_threshold_s
+                 else "dispatch")
+        self._acc[phase] += float(seconds)
+        return phase
+
+    def finish(self, now: float | None = None
+               ) -> tuple[float, dict[str, float], float]:
+        """Close the epoch: ``(wall_s, phases, goodput)``.
+
+        ``phases['host_other']`` is the unbracketed residual, clamped
+        at zero (a double-counted bracket can push the named sum past
+        the wall; the epoch record keeps the raw sum so the telemetry
+        test catches that as sum > wall)."""
+        if self._t0 is None:
+            raise RuntimeError("finish() before begin_epoch()")
+        now = time.perf_counter() if now is None else now
+        wall = max(now - self._t0, 0.0)
+        phases = dict(self._acc)
+        named = sum(v for k, v in phases.items() if k != "host_other")
+        phases["host_other"] = max(wall - named, 0.0)
+        useful = phases["dispatch"] + phases["step_drain"]
+        goodput = min(useful / wall, 1.0) if wall > 0 else 0.0
+        self._t0 = None
+        return wall, phases, goodput

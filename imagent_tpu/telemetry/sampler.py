@@ -1,0 +1,81 @@
+"""Step-time sampler: host-side ring buffer of dispatch-to-dispatch
+latencies.
+
+The engine's host-sync discipline (``engine.py`` module docstring, the
+``_GUARD_LAG`` pattern) forbids a per-step device sync just to time
+steps — so this sampler never looks at the device at all.  It records
+the host timestamp at which each step *dispatch returned*; the interval
+between consecutive returns is the steady-state step cadence, because
+on a saturated pipeline the host dispatches exactly one step per device
+step (the dispatch queue exerts backpressure through the metric-buffer
+guard and the prefetch queue).  The numbers are therefore cadence
+(throughput truth), not single-step device latency — exactly what
+straggler detection and goodput need.
+
+Per-epoch percentiles (p50/p95/p99) come from a fixed-capacity ring
+buffer: a 4096-entry ring holds every step of any realistic epoch
+snapshot while bounding memory for million-step runs (oldest samples
+overwritten — percentiles describe the epoch's tail, which is what the
+pod aggregation compares).
+
+This module is imported per training step and must stay jax-free: no
+device handles, no syncs, O(1) per sample (both asserted by
+``tests/test_telemetry.py``).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+DEFAULT_CAPACITY = 4096
+
+
+class StepTimeSampler:
+    """Ring buffer of dispatch-to-dispatch intervals, reset per epoch."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError("sampler capacity must be >= 1")
+        self._buf = np.zeros(capacity, np.float64)
+        self._i = 0          # next write slot
+        self._n = 0          # valid samples (<= capacity)
+        self._last: float | None = None
+
+    def epoch_reset(self) -> None:
+        self._i = 0
+        self._n = 0
+        self._last = None
+
+    def mark(self, now: float | None = None) -> None:
+        """A step dispatch just returned.  O(1): one subtract, one
+        array store — no allocation, no device access."""
+        now = time.perf_counter() if now is None else now
+        if self._last is not None:
+            self._buf[self._i] = now - self._last
+            self._i = (self._i + 1) % len(self._buf)
+            if self._n < len(self._buf):
+                self._n += 1
+        self._last = now
+
+    @property
+    def n(self) -> int:
+        return self._n
+
+    def intervals_ms(self) -> np.ndarray:
+        """The buffered intervals in milliseconds (unordered)."""
+        return self._buf[: self._n] * 1e3
+
+    def percentiles(self) -> dict[str, float]:
+        """``{p50_ms, p95_ms, p99_ms, n}`` over the buffered epoch.
+
+        With no samples (0- or 1-step epoch) every percentile is 0.0 —
+        the aggregation treats an idle host as trivially non-straggling.
+        """
+        if self._n == 0:
+            return {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0, "n": 0}
+        ms = self.intervals_ms()
+        p50, p95, p99 = np.percentile(ms, (50.0, 95.0, 99.0))
+        return {"p50_ms": float(p50), "p95_ms": float(p95),
+                "p99_ms": float(p99), "n": int(self._n)}

@@ -38,6 +38,7 @@ from typing import Any, Callable
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -746,9 +747,29 @@ def make_eval_step(model, mesh: Mesh,
 
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
     """Place the state replicated over the mesh — the DDP initial
-    parameter broadcast (``imagenet.py:316``) done by sharding layout."""
+    parameter broadcast (``imagenet.py:316``) done by sharding layout.
+
+    Multi-host placement goes through
+    ``make_array_from_process_local_data``, NOT ``jax.device_put``:
+    device_put of a host array onto a non-fully-addressable sharding
+    runs a per-leaf ``assert_equal`` safety broadcast — the ENTIRE
+    model crosses the wire at startup just to verify what same-seed
+    init already guarantees (``engine._run``: every process builds the
+    identical state from ``jax.random.key(cfg.seed)``). On a pod that
+    is O(model-size) startup traffic; on the CPU/gloo test backend the
+    hundreds-of-collectives storm is also the main reorder-abort
+    hazard. The local-data path uploads each host's own copy to its
+    own devices with zero cross-host ops."""
     sharding = NamedSharding(mesh, P())
-    return jax.device_put(state, sharding)
+    if jax.process_count() == 1:
+        return jax.device_put(state, sharding)
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(sharding, x,
+                                                      x.shape)
+
+    return jax.tree.map(put, state)
 
 
 def place_state(state: TrainState, mesh: Mesh,

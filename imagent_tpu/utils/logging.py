@@ -73,6 +73,44 @@ class TrainLogger:
                                    val["h2d_bytes"] / 1e6, epoch)
         self.writer.flush()
 
+    def telemetry(self, epoch: int, record: dict,
+                  step_intervals_ms=None) -> None:
+        """TensorBoard series for one telemetry epoch record
+        (``telemetry.TelemetrySession.epoch_end``): goodput phases,
+        step-time percentiles (+ distribution histogram), pod
+        aggregates, HBM. The same numbers land in ``telemetry.jsonl``
+        — TB is for eyeballs, the JSONL for tools."""
+        if self.writer is None:
+            return
+        w = self.writer
+        w.add_scalar("goodput/fraction", record["goodput"], epoch)
+        for name, secs in record["phases"].items():
+            # `name` ranges over telemetry/goodput.py::PHASES — a fixed
+            # 8-member taxonomy, so the series family is bounded.
+            w.add_scalar(f"goodput/{name}_s", secs, epoch)  # jaxlint: disable=telemetry-tag-format -- tag family bounded by the fixed PHASES taxonomy, not per-step values
+        sm = record["step_ms"]
+        w.add_scalar("steptime/p50_ms", sm["p50_ms"], epoch)
+        w.add_scalar("steptime/p95_ms", sm["p95_ms"], epoch)
+        w.add_scalar("steptime/p99_ms", sm["p99_ms"], epoch)
+        if step_intervals_ms is not None and len(step_intervals_ms):
+            w.add_histogram("steptime/dist_ms", step_intervals_ms,
+                            epoch)
+        hosts = record["hosts"]["stats"]
+        w.add_scalar("pod/input_wait_max_s",
+                     hosts["input_wait_s"]["max"], epoch)
+        w.add_scalar("pod/step_p95_max_ms",
+                     hosts["step_p95_ms"]["max"], epoch)
+        w.add_scalar("pod/stragglers", len(record["stragglers"]),
+                     epoch)
+        hbm = record.get("hbm") or {}
+        if "bytes_in_use" in hbm:
+            w.add_scalar("hbm/bytes_in_use_mb",
+                         hbm["bytes_in_use"] / 1e6, epoch)
+        if "peak_bytes_in_use" in hbm:
+            w.add_scalar("hbm/peak_mb",
+                         hbm["peak_bytes_in_use"] / 1e6, epoch)
+        w.flush()
+
     def final_summary(self, best_epoch: int, best_top1: float,
                       best_top5: float, total_minutes: float) -> None:
         """Reference's end-of-run block (``imagenet.py:422-429``,

@@ -13,7 +13,10 @@ Format (tensorflow/core/lib/io/record_writer.cc):
 with CRC32C (Castagnoli) and the TF mask ((c>>15 | c<<17) + 0xa282ead8).
 Event proto fields used: wall_time(1, double), step(2, varint),
 file_version(3, string), summary(5) -> Summary.Value{tag(1),
-simple_value(2, float)}.
+simple_value(2, float), histo(5, HistogramProto)}.  HistogramProto:
+min(1)/max(2)/num(3)/sum(4)/sum_squares(5) doubles, bucket_limit(6)
+and bucket(7) packed repeated doubles — enough for TensorBoard's
+HISTOGRAMS tab (step-time distributions, telemetry subsystem).
 """
 
 from __future__ import annotations
@@ -84,6 +87,48 @@ def _scalar_summary(tag: str, value: float) -> bytes:
     return _field_bytes(1, v)  # Summary.value
 
 
+def _double_field(num: int, value: float) -> bytes:
+    return _varint((num << 3) | 1) + struct.pack("<d", value)
+
+
+def _packed_doubles(num: int, values) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in values)
+    return _field_bytes(num, payload)
+
+
+def histogram_buckets(values, bins: int = 30):
+    """Uniform bucketing: ``(min, max, sum, sum_sq, limits, counts)``.
+
+    TB's HistogramProto semantics: ``counts[i]`` falls in
+    ``(limits[i-1], limits[i]]``; the last limit must be >= max. A
+    constant sample set degenerates to one bucket around the value."""
+    vals = [float(v) for v in values]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0.0:
+        # All-equal samples: one bucket whose limit covers the value.
+        return lo, hi, sum(vals), sum(v * v for v in vals), \
+            [hi + 1e-12], [float(len(vals))]
+    limits = [lo + span * (i + 1) / bins for i in range(bins)]
+    counts = [0.0] * bins
+    for v in vals:
+        i = min(int((v - lo) / span * bins), bins - 1)
+        counts[i] += 1.0
+    return lo, hi, sum(vals), sum(v * v for v in vals), limits, counts
+
+
+def _histogram_summary(tag: str, values, bins: int = 30) -> bytes:
+    vals = [float(v) for v in values]  # materialize once (generators)
+    lo, hi, total, sum_sq, limits, counts = histogram_buckets(vals,
+                                                              bins)
+    histo = (_double_field(1, lo) + _double_field(2, hi)
+             + _double_field(3, float(len(vals)))
+             + _double_field(4, total) + _double_field(5, sum_sq)
+             + _packed_doubles(6, limits) + _packed_doubles(7, counts))
+    v = _field_bytes(1, tag.encode()) + _field_bytes(5, histo)
+    return _field_bytes(1, v)  # Summary.value
+
+
 def _event(wall_time: float, step: int | None = None,
            file_version: str | None = None,
            summary: bytes | None = None) -> bytes:
@@ -125,6 +170,17 @@ class EventWriter:
         self._record(_event(time.time(), step=step,
                             summary=_scalar_summary(tag, float(value))))
 
+    def histogram(self, tag: str, values, step: int,
+                  bins: int = 30) -> None:
+        """One histogram point (TB HISTOGRAMS tab). ``values``: the raw
+        samples (e.g. an epoch's step-time intervals); bucketed
+        uniformly here — empty input writes nothing."""
+        vals = list(values)
+        if not vals:
+            return
+        self._record(_event(time.time(), step=step,
+                            summary=_histogram_summary(tag, vals, bins)))
+
     def flush(self) -> None:
         self._f.flush()
 
@@ -134,9 +190,10 @@ class EventWriter:
 
 class SummaryWriter:
     """The ``torch.utils.tensorboard.SummaryWriter`` subset the
-    framework uses: ``add_scalar`` (one run) and ``add_scalars``
+    framework uses: ``add_scalar`` (one run), ``add_scalars``
     (torch-compatible ``<logdir>/<tag>_<series>`` sub-runs so
-    train/test land on one chart)."""
+    train/test land on one chart), and ``add_histogram``
+    (distributions — step-time telemetry)."""
 
     def __init__(self, log_dir: str):
         self.log_dir = log_dir
@@ -145,6 +202,10 @@ class SummaryWriter:
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:
         self._main.scalar(tag, value, step)
+
+    def add_histogram(self, tag: str, values, step: int,
+                      bins: int = 30) -> None:
+        self._main.histogram(tag, values, step, bins)
 
     def add_scalars(self, main_tag: str, series: dict, step: int) -> None:
         for name, value in series.items():

@@ -1,0 +1,102 @@
+"""Split-brain restore drill (2 OS processes): a checkpoint that is
+unreadable on ONE host must advance the WHOLE pod to the next fallback
+candidate together (ROADMAP open item; checkpoint._pod_agree +
+integrity.probe).
+
+Scenario: both ranks save two checkpoint generations — ``last``
+(epoch 1) and its rotated predecessor ``last.1`` (epoch 0) — then each
+rank restores from its OWN replica of the checkpoint directory (the
+per-host-storage topology). Rank 1's replica of ``last`` is torn (one
+file truncated — what a kill racing a replica sync leaves). Process
+0's hash verdict is clean (its copy is fine), so only the per-host
+readability probe can see the tear; without its min-reduced verdict
+rank 0 would restore ``last`` (epoch 1) while rank 1 walked on to
+``last.1`` (epoch 0) — a desynchronized pod. With it, BOTH ranks must
+restore ``last.1`` / epoch 0 and print identical RESTORED lines.
+
+Usage: python mp_worker_restore.py <rank> <port> <world>  (scratch dir
+via IMAGENT_MP_SCRATCH).
+"""
+
+import os
+import shutil
+import sys
+
+
+def main() -> int:
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    scratch = os.environ["IMAGENT_MP_SCRATCH"]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2")
+    os.environ.update({
+        "SLURM_JOB_NUM_NODES": "2",
+        "SLURM_NODEID": str(rank),
+        "SLURM_LOCALID": "0",
+        "SLURM_PROCID": str(rank),
+        "SLURM_NTASKS": "2",
+        "SLURM_JOB_NODELIST": "127.0.0.1",
+    })
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu import cluster
+    from imagent_tpu.models.vit import VisionTransformer
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, replicate_state,
+    )
+
+    senv = cluster.initialize("cpu", port=port)
+    assert senv is not None and senv.world_size == 2
+    mesh = cluster.make_mesh()
+
+    model = VisionTransformer(patch_size=8, hidden_dim=32, num_layers=1,
+                              num_heads=2, mlp_dim=32, num_classes=4)
+    state = replicate_state(
+        create_train_state(model, jax.random.key(0), 16,
+                           make_optimizer()), mesh)
+
+    shared = os.path.join(scratch, "ck")
+    # Two durable generations: the second save rotates the first live
+    # `last` (epoch 0) to `last.1`.
+    ckpt_lib.save(shared, ckpt_lib.LAST, state, {"epoch": 0},
+                  keep_last_k=1)
+    ckpt_lib.save(shared, ckpt_lib.LAST, state, {"epoch": 1},
+                  keep_last_k=1)
+    # The integrity manifest is hashed on a process-0 background thread
+    # (checkpoint._write_manifest_bg) joined by process 0's save() —
+    # but rank 1's save() returns at the commit barrier, possibly
+    # before the manifest lands. Barrier so the replicas copied below
+    # include it.
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("drill_manifests_durable")
+
+    # Per-host storage replicas: each rank restores from its own copy.
+    ckpt_dir = os.path.join(scratch, f"replica{rank}")
+    shutil.copytree(shared, ckpt_dir)
+    if rank == 1:
+        # Tear rank 1's `last`: truncate its largest file to half —
+        # the on-disk state a kill racing a replica sync leaves.
+        root = os.path.join(ckpt_dir, ckpt_lib.LAST)
+        victim, vsize = None, -1
+        for dirpath, _, filenames in os.walk(root):
+            for fn in filenames:
+                full = os.path.join(dirpath, fn)
+                if os.path.getsize(full) > vsize:
+                    victim, vsize = full, os.path.getsize(full)
+        with open(victim, "r+b") as f:
+            f.truncate(vsize // 2)
+
+    restored = ckpt_lib.restore_resilient(ckpt_dir, state)
+    assert restored is not None, "fallback chain came up empty"
+    _, meta, cand = restored
+    print(f"RESTORED {cand} {int(meta['epoch'])}", flush=True)
+
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,65 @@
+"""Pod telemetry drill worker (2 OS processes): the full engine runs
+distributed on CPU — synthetic data, 2 fake devices per process — and
+the telemetry subsystem must produce a valid ``telemetry.jsonl`` on
+process 0 with POD-aggregated per-host stats (the once-per-epoch
+allgather crossing the process boundary for real).
+
+The parent (tests/test_telemetry.py) parses the JSONL and asserts the
+acceptance contract: goodput phases summing to >=95% of measured epoch
+wall, hosts.count == 2, step-time percentiles populated.
+
+Usage: python mp_worker_telemetry.py <rank> <port> <world>  (scratch
+dir via IMAGENT_MP_SCRATCH).
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    scratch = os.environ["IMAGENT_MP_SCRATCH"]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2")
+    os.environ.update({
+        "SLURM_JOB_NUM_NODES": "2",
+        "SLURM_NODEID": str(rank),
+        "SLURM_LOCALID": "0",
+        "SLURM_PROCID": str(rank),
+        "SLURM_NTASKS": "2",
+        "SLURM_JOB_NODELIST": "127.0.0.1",
+        "IMAGENT_COORDINATOR_PORT": str(port),
+    })
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from imagent_tpu.config import Config
+    from imagent_tpu.engine import run
+
+    # 2 procs x 2 fake devices -> global batch 16, 64 imgs -> 4
+    # steps/epoch; 2 epochs with an eval epoch. save_model stays OFF:
+    # orbax's ASYNC save finalizes on a background thread whose
+    # internal barrier is a gloo psum on this backend, and gloo aborts
+    # when two threads interleave collectives differently across ranks
+    # (on TPU the runtime serializes per-device program order, so the
+    # same overlap is benign). The checkpoint/recovery phases are
+    # exercised by the single-process drills (test_fault_drills.py).
+    cfg = Config(arch="resnet18", image_size=16, num_classes=4,
+                 batch_size=4, epochs=2, lr=0.05, dataset="synthetic",
+                 synthetic_size=64, workers=0, bf16=False, log_every=0,
+                 seed=0, save_model=False, backend="cpu", eval_every=2,
+                 log_dir=os.path.join(scratch, "tb"),
+                 ckpt_dir=os.path.join(scratch, "ck"))
+    result = run(cfg)
+    assert result["rollbacks"] == 0 and not result["preempted"], result
+    print(f"RUN_OK rank={rank} best_epoch={result['best_epoch']}",
+          flush=True)
+
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

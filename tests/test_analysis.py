@@ -38,11 +38,11 @@ def rules_fired(findings):
     return {f.rule for f in findings}
 
 
-def test_registry_has_all_seven_rules():
+def test_registry_has_all_eight_rules():
     assert set(RULES) == {
         "host-sync-in-jit", "prng-key-reuse", "recompile-hazard",
         "nondeterministic-pytree-order", "missing-donation",
-        "dtype-contract", "untimed-block"}
+        "dtype-contract", "untimed-block", "telemetry-tag-format"}
     for r in RULES.values():
         assert r.doc  # every rule documents why it bites
 
@@ -516,6 +516,51 @@ def test_untimed_silent_with_sync_or_outside_benchmarks(tmp_path):
     # Timing without sync in non-benchmark code is out of scope.
     assert lint_src(tmp_path, UNTIMED_BAD, rel="pkg/loop_fix.py",
                     rule="untimed-block") == []
+
+
+# -------------------------------------------------------------- rule 8
+
+TAG_FSTRING_BAD = """
+def log_steps(writer, losses):
+    for i, loss in enumerate(losses):
+        writer.add_scalar(f"loss/step_{i}", loss, i)
+"""
+
+TAG_CASE_BAD = """
+def log_epoch(writer, m, epoch):
+    writer.add_scalar("Top1 accuracy", m, epoch)
+    writer.add_histogram("stepTime/dist", [m], epoch)
+"""
+
+TAG_GOOD = """
+def log_epoch(writer, m, epoch, group):
+    writer.add_scalar("goodput/fraction", m, epoch)
+    writer.add_scalar("steptime/p95_ms", m, epoch)
+    writer.add_histogram("steptime/dist_ms", [m], epoch)
+    # Variable tags are out of scope (bounded families document
+    # themselves at the call site).
+    writer.add_scalars(group, {"train": m}, epoch)
+    # Non-writer methods with stringy first args stay silent.
+    writer.add_text("Whatever Case", "x", epoch)
+"""
+
+
+def test_telemetry_tag_fstring_fires(tmp_path):
+    findings = lint_src(tmp_path, TAG_FSTRING_BAD,
+                        rule="telemetry-tag-format")
+    assert len(findings) == 1
+    assert "NEW" in findings[0].message  # unbounded-series warning
+
+
+def test_telemetry_tag_case_fires(tmp_path):
+    findings = lint_src(tmp_path, TAG_CASE_BAD,
+                        rule="telemetry-tag-format")
+    assert len(findings) == 2  # space+case, camelCase namespace
+
+
+def test_telemetry_tag_good_silent(tmp_path):
+    assert lint_src(tmp_path, TAG_GOOD,
+                    rule="telemetry-tag-format") == []
 
 
 # ------------------------------------------------- suppressions/baseline

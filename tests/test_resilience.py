@@ -382,3 +382,30 @@ def test_loader_quarantines_unreadable_file(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "quarantined" in out and "1 unreadable" in out
     loader.close()
+
+
+# ------------------------------------------------- multi-host restore
+
+def test_multihost_restore_split_brain_drill(tmp_path):
+    """TRUE 2-process drill (ROADMAP open item): an Orbax restore
+    exception on ONE host must advance the WHOLE pod to the next
+    fallback candidate. The worker saves two checkpoint generations,
+    injects a rank-1-only restore failure on `last`, and both ranks
+    must agree on `last.1` / epoch 0 — without the exception allgather
+    (checkpoint._pod_agree) rank 0 would return `last` (epoch 1) while
+    rank 1 fell back, desynchronizing the pod."""
+    from mp_launch import launch_pair
+
+    os.environ["IMAGENT_MP_SCRATCH"] = str(tmp_path)
+    try:
+        outs = launch_pair("mp_worker_restore.py")
+    finally:
+        del os.environ["IMAGENT_MP_SCRATCH"]
+    lines = []
+    for out in outs:
+        restored = [ln for ln in out.splitlines()
+                    if ln.startswith("RESTORED")]
+        assert restored, out
+        lines.append(restored[0].split())
+    assert lines[0] == lines[1], f"pod split-brain: {lines}"
+    assert lines[0] == ["RESTORED", "last.1", "0"], lines[0]

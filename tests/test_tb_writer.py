@@ -153,3 +153,114 @@ def test_varint_negative_terminates():
         n |= (b & 0x7F) << shift
         shift += 7
     assert n == (1 << 64) - 1
+
+
+# ---------------------------------------------------------- histograms
+
+def _parse_histo_event(payload):
+    """Independent proto walk for histogram events: returns
+    (step, tag, {min, max, num, sum, sum_squares, limits, counts})."""
+
+    def varint(buf, j):
+        n = 0; shift = 0
+        while True:
+            b = buf[j]; j += 1
+            n |= (b & 0x7F) << shift; shift += 7
+            if not b & 0x80:
+                return n, j
+
+    def fields(buf):
+        j = 0
+        while j < len(buf):
+            key, j = varint(buf, j)
+            num, wire = key >> 3, key & 7
+            if wire == 1:
+                yield num, struct.unpack("<d", buf[j:j + 8])[0]
+                j += 8
+            elif wire == 0:
+                v, j = varint(buf, j)
+                yield num, v
+            elif wire == 2:
+                ln, j = varint(buf, j)
+                yield num, buf[j:j + ln]
+                j += ln
+            elif wire == 5:
+                yield num, struct.unpack("<f", buf[j:j + 4])[0]
+                j += 4
+            else:
+                raise AssertionError(f"wire {wire}")
+
+    step = tag = histo = None
+    for num, v in fields(payload):
+        if num == 2:
+            step = v
+        elif num == 5:  # summary
+            for vn, vv in fields(v):
+                assert vn == 1  # Summary.value
+                for fn, fv in fields(vv):
+                    if fn == 1:
+                        tag = fv.decode()
+                    elif fn == 5:  # histo
+                        h = {"limits": [], "counts": []}
+                        for hn, hv in fields(fv):
+                            if hn in (1, 2, 3, 4, 5):
+                                h[{1: "min", 2: "max", 3: "num",
+                                   4: "sum", 5: "sum_squares"}[hn]] = hv
+                            elif hn == 6:  # packed doubles
+                                h["limits"] = [
+                                    struct.unpack("<d", hv[k:k + 8])[0]
+                                    for k in range(0, len(hv), 8)]
+                            elif hn == 7:
+                                h["counts"] = [
+                                    struct.unpack("<d", hv[k:k + 8])[0]
+                                    for k in range(0, len(hv), 8)]
+                        histo = h
+    return step, tag, histo
+
+
+def test_histogram_roundtrip(tmp_path):
+    w = EventWriter(str(tmp_path))
+    samples = [1.0, 2.0, 2.5, 3.0, 10.0]
+    w.histogram("steptime/dist_ms", samples, 5, bins=4)
+    w.histogram("steptime/dist_ms", [], 6)  # empty: writes nothing
+    w.close()
+    (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    records = _read_records(path)  # CRCs verified inside
+    assert len(records) == 2  # file_version + ONE histogram
+    step, tag, h = _parse_histo_event(records[1])
+    assert (step, tag) == (5, "steptime/dist_ms")
+    assert h["min"] == 1.0 and h["max"] == 10.0 and h["num"] == 5.0
+    assert h["sum"] == sum(samples)
+    assert abs(h["sum_squares"] - sum(v * v for v in samples)) < 1e-9
+    assert len(h["limits"]) == len(h["counts"]) == 4
+    assert sum(h["counts"]) == 5.0  # every sample landed in a bucket
+    assert h["limits"][-1] >= h["max"]  # TB bucket contract
+    # Monotone limits (HistogramProto requirement).
+    assert h["limits"] == sorted(h["limits"])
+
+
+def test_histogram_constant_samples_degenerate_bucket(tmp_path):
+    w = EventWriter(str(tmp_path))
+    w.histogram("steptime/dist_ms", [5.0] * 8, 0)
+    w.close()
+    (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    _, _, h = _parse_histo_event(_read_records(path)[1])
+    assert h["num"] == 8.0 and h["min"] == h["max"] == 5.0
+    assert h["counts"] == [8.0] and h["limits"][0] > 5.0
+
+
+def test_histogram_readable_by_real_tensorboard(tmp_path):
+    pytest.importorskip("tensorboard")
+    from tensorboard.backend.event_processing import event_accumulator
+
+    w = SummaryWriter(str(tmp_path))
+    w.add_histogram("steptime/dist_ms", [1.0, 2.0, 3.0, 100.0], 0)
+    w.close()
+    ea = event_accumulator.EventAccumulator(
+        str(tmp_path),
+        size_guidance={event_accumulator.HISTOGRAMS: 0})
+    ea.Reload()
+    assert "steptime/dist_ms" in ea.Tags()["histograms"]
+    (h,) = ea.Histograms("steptime/dist_ms")
+    v = h.histogram_value
+    assert v.num == 4.0 and v.min == 1.0 and v.max == 100.0
