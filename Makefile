@@ -22,11 +22,13 @@ lint:
 
 # Fast confidence tier (<5 min on CPU): the lint gate, the resilience
 # unit tests, the end-to-end fault-injection drills (torn checkpoint,
-# NaN rollback, watchdog, SIGTERM), and the core e2e train/resume
-# smoke.
+# NaN rollback, watchdog, SIGTERM, slow/failed async commits), the
+# async-checkpoint drills (incl. the 2-process mid-commit-kill
+# acceptance drill), and the core e2e train/resume smoke.
 smoke: lint
 	$(PYTEST) -m "not slow" tests/test_resilience.py \
-	    tests/test_fault_drills.py tests/test_e2e.py
+	    tests/test_fault_drills.py tests/test_ckpt_async.py \
+	    tests/test_e2e.py
 
 # The full tier-1 gate (what CI runs).
 test:
@@ -34,6 +36,10 @@ test:
 
 # Tiny synthetic-data bench iteration through the real input path
 # (uint8 wire -> device_prefetch -> in-graph normalize -> step) on the
-# CPU backend: catches input-path crashes before a real bench run.
+# CPU backend, plus the async-checkpoint telemetry regression gate
+# (blocking `checkpoint` phase < 10% of the synchronous baseline, the
+# moved work accounted in `ckpt_commit_async`, phases still summing to
+# wall): catches input-path crashes AND critical-path regressions
+# before a real bench run.
 bench-smoke:
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/bench_smoke.py

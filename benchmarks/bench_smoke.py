@@ -1,16 +1,33 @@
-"""CPU-backend input-path smoke bench (``make bench-smoke``).
+"""CPU-backend smoke bench (``make bench-smoke``): input path + the
+async-checkpoint telemetry regression gate.
 
-A tiny synthetic-data bench iteration through the REAL input path —
-SyntheticLoader (uint8 wire, ``data/pipeline.py`` Batch contract) →
-``device_prefetch`` staging (with the starvation counters) → the jitted
-train step with in-graph dequantize+normalize → one masked eval batch —
-on the CPU backend, no TPU required. CI runs this so an input-path
-crash (wire-dtype regression, Batch contract break, prefetch deadlock)
-surfaces here, in under a minute, instead of burning a real bench run.
+Stage 1 — input path: a tiny synthetic-data bench iteration through the
+REAL input path — SyntheticLoader (uint8 wire, ``data/pipeline.py``
+Batch contract) → ``device_prefetch`` staging (with the starvation
+counters) → the jitted train step with in-graph dequantize+normalize →
+one masked eval batch — on the CPU backend, no TPU required. CI runs
+this so an input-path crash (wire-dtype regression, Batch contract
+break, prefetch deadlock) surfaces here, in under a minute, instead of
+burning a real bench run.
 
-Prints one JSON line (throughput is incidental — a CPU number on a
-tiny model; the PASS signal is the point) and exits non-zero on any
-crash or a non-finite loss.
+Stage 2 — checkpoint critical-path regression: two 2-epoch engine runs
+with checkpointing on and a deterministic ``ckpt.slow_commit`` fault
+armed on epoch 0's LAST commit — one with ``--no-async-ckpt``
+(synchronous baseline: the injected commit latency lands in the
+blocking ``checkpoint`` phase), one with the default async path (the
+same latency runs on the committer thread, hidden under epoch 1's
+compute). The gate asserts, from IN-RUN telemetry (no wall-clock
+comparisons between machines): the async run's epoch-0 blocking
+``checkpoint`` phase is < 10% of the synchronous run's; the moved work
+shows up in the overlapped ``ckpt_commit_async`` phase; and every
+epoch's phases still sum to its measured wall (the accounting
+invariant the overlap must not break). The comparison is pinned to
+epoch 0 — ``eval_every=2`` keeps it free of the eval and BEST-save
+costs both runs pay identically (and synchronously) at the final
+epoch.
+
+Prints one JSON line per stage and exits non-zero on any crash, a
+non-finite loss, or a telemetry-regression violation.
 """
 
 from __future__ import annotations
@@ -25,8 +42,13 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+# The injected final-commit latency: big enough that a regression (the
+# sleep landing on the critical path) dwarfs scheduler noise in the
+# blocking-phase comparison, small enough to keep the bench fast.
+_SLOW_COMMIT_SECS = 1.0
 
-def main() -> int:
+
+def _input_path_stage() -> int:
     import jax
 
     from imagent_tpu.cluster import make_mesh
@@ -72,13 +94,17 @@ def main() -> int:
         print(f"FAIL: bad train metrics {m}", file=sys.stderr)
         return 1
 
+    # Dispatch-then-fetch: the metric read happens OUTSIDE the
+    # prefetched loop (blocking-call-in-step-loop lint invariant).
+    eval_metrics = None
     for gi, gl, gm in device_prefetch(mesh, val_loader.epoch(0),
                                       with_mask=True):
-        em = np.asarray(eval_step(state, gi, gl, gm))
-        if not np.isfinite(em).all():
-            print(f"FAIL: bad eval metrics {em}", file=sys.stderr)
-            return 1
+        eval_metrics = eval_step(state, gi, gl, gm)
         break
+    em = np.asarray(eval_metrics)
+    if not np.isfinite(em).all():
+        print(f"FAIL: bad eval metrics {em}", file=sys.stderr)
+        return 1
 
     print(json.dumps({
         "metric": "bench_smoke_input_path",
@@ -91,6 +117,100 @@ def main() -> int:
         "backend": jax.devices()[0].platform,
     }))
     return 0
+
+
+def _ckpt_run(root: str, tag: str, async_on: bool) -> list[dict]:
+    """A 2-epoch CPU engine run with checkpointing on and the final
+    LAST commit slowed deterministically; returns its telemetry epoch
+    records."""
+    from imagent_tpu.config import Config
+    from imagent_tpu.engine import run
+    from imagent_tpu.resilience import faultinject
+    from imagent_tpu.telemetry import read_events
+
+    log_dir = os.path.join(root, f"tb_{tag}")
+    cfg = Config(arch="resnet18", image_size=16, num_classes=4,
+                 batch_size=4, epochs=2, lr=0.05, dataset="synthetic",
+                 synthetic_size=128, workers=0, bf16=False, log_every=0,
+                 seed=0, save_model=True, keep_last_k=1,
+                 # eval_every=2: epoch 0 has no eval and no BEST save —
+                 # its checkpoint phase is EXACTLY the LAST-save cost
+                 # the async path moves off the critical path.
+                 eval_every=2, async_ckpt=async_on,
+                 # Epoch 0's LAST commit sleeps; the async committer
+                 # hides it under epoch 1's compute and lands it at the
+                 # next boundary.
+                 faults=f"ckpt.slow_commit:secs={_SLOW_COMMIT_SECS}",
+                 log_dir=log_dir, ckpt_dir=os.path.join(root, f"ck_{tag}"))
+    try:
+        result = run(cfg)
+    finally:
+        faultinject.reset()
+    if result["preempted"] or result["rollbacks"]:
+        raise RuntimeError(f"{tag} run degraded: {result}")
+    events = read_events(os.path.join(log_dir, "telemetry.jsonl"))
+    return [e for e in events if e["event"] == "epoch"]
+
+
+def _ckpt_regression_stage() -> int:
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="bench_ckpt_")
+    sync_eps = _ckpt_run(root, "sync", async_on=False)
+    async_eps = _ckpt_run(root, "async", async_on=True)
+
+    failures = []
+    for tag, eps in (("sync", sync_eps), ("async", async_eps)):
+        for rec in eps:
+            phase_sum = sum(rec["phases"].values())
+            # host_other absorbs the residual, so the partition must
+            # cover (almost all of) the wall — the overlap phase must
+            # NOT be needed to close the books.
+            if phase_sum < 0.95 * rec["wall_s"]:
+                failures.append(
+                    f"{tag} epoch {rec['epoch']}: phases sum "
+                    f"{phase_sum:.3f}s < 95% of wall {rec['wall_s']}s")
+    # Epoch 0 only: pure LAST-save cost (no eval/BEST, eval_every=2).
+    sync_ckpt = sync_eps[0]["phases"]["checkpoint"]
+    async_ckpt = async_eps[0]["phases"]["checkpoint"]
+    async_overlap = sum(r["overlap"]["ckpt_commit_async"]
+                        for r in async_eps)
+    sync_overlap = sum(r["overlap"]["ckpt_commit_async"]
+                       for r in sync_eps)
+    if sync_ckpt < _SLOW_COMMIT_SECS:
+        failures.append(
+            f"sync blocking checkpoint phase {sync_ckpt:.3f}s missed "
+            f"the injected {_SLOW_COMMIT_SECS}s commit latency — the "
+            "baseline itself is not attributing")
+    if async_ckpt >= 0.1 * sync_ckpt:
+        failures.append(
+            f"async blocking checkpoint phase {async_ckpt:.3f}s is not "
+            f"< 10% of the synchronous baseline {sync_ckpt:.3f}s — the "
+            "commit is back on the critical path")
+    if async_overlap <= 0.0:
+        failures.append("async run recorded no ckpt_commit_async "
+                        "overlap — the moved work is unaccounted")
+    if sync_overlap != 0.0:
+        failures.append(f"sync run recorded {sync_overlap}s of async "
+                        "overlap — attribution leak")
+    print(json.dumps({
+        "metric": "bench_ckpt_async",
+        "status": "FAIL" if failures else "PASS",
+        "sync_checkpoint_s": round(sync_ckpt, 3),
+        "async_checkpoint_s": round(async_ckpt, 3),
+        "async_overlap_s": round(async_overlap, 3),
+        "injected_commit_s": _SLOW_COMMIT_SECS,
+    }))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+def main() -> int:
+    rc = _input_path_stage()
+    if rc:
+        return rc
+    return _ckpt_regression_stage()
 
 
 if __name__ == "__main__":

@@ -12,7 +12,10 @@ parameter+optimizer HBM footprint; an implicit-dtype array on the wire
 path quietly re-inflates the uint8 wire format to float64; a benchmark
 that stops its timer without a device sync measures dispatch, not work;
 a TensorBoard tag interpolating a step number mints a fresh series
-every step until the dashboard (and the event file) drowns.
+every step until the dashboard (and the event file) drowns; a blocking
+device→host fetch on an in-flight result inside the prefetched step
+loop re-introduces the per-step sync the async dispatch pipeline
+exists to avoid.
 
 Detection is intra-module and intentionally conservative: a rule fires
 only on patterns it can see whole (see docs/STATIC_ANALYSIS.md for the
@@ -818,3 +821,104 @@ def check_untimed_block(ctx: ModuleContext) -> Iterator[Finding]:
                 "the device (block_until_ready / device_get / hard "
                 "np.asarray fetch): jax dispatch is async, so the "
                 "measured time is queueing, not compute")
+
+
+# --------------------------------------------------------------------------
+# Rule 9: blocking-call-in-step-loop
+# --------------------------------------------------------------------------
+
+# The prefetched step loop's invariant (engine.py): the loop body
+# dispatches asynchronously and NOTHING in it blocks on an in-flight
+# step result — metrics are consumed by a frontier lagged _GUARD_LAG
+# steps behind the dispatch (already retired → the fetch is free).
+_STEP_LOOP_SOURCES = {"device_prefetch", "Prefetcher"}
+_BLOCKING_FETCH_CALLS = {"numpy.asarray", "numpy.array",
+                         "jax.device_get", "jax.block_until_ready"}
+_BLOCKING_FETCH_METHODS = {"item", "tolist", "block_until_ready"}
+_LAG_SENTINEL = "_GUARD_LAG"
+
+
+def _has_step_source_call(node: ast.AST, ctx: ModuleContext,
+                          loop_vars: set[str]) -> bool:
+    """Whether an expression contains a device_prefetch/Prefetcher call
+    or references a name bound from one."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            qual = ctx.qual(sub.func) or ""
+            if qual.rsplit(".", 1)[-1] in _STEP_LOOP_SOURCES:
+                return True
+        elif isinstance(sub, ast.Name) and sub.id in loop_vars:
+            return True
+    return False
+
+
+@rule("blocking-call-in-step-loop",
+      "blocking device→host fetch on an in-flight step result inside a "
+      "prefetched step loop — re-introduces the per-step sync; read "
+      "from the _GUARD_LAG-lagged frontier instead")
+def check_blocking_in_step_loop(ctx: ModuleContext) -> Iterator[Finding]:
+    """Fires on ``np.asarray``/``np.array``/``jax.device_get``/
+    ``jax.block_until_ready`` calls and ``.item()``/``.tolist()``/
+    ``.block_until_ready()`` methods inside the body of a ``for`` loop
+    that iterates ``device_prefetch(...)``/``Prefetcher(...)`` (or a
+    name assigned from one, tracked in source order) — the engine's
+    step loops.  Exemption: a statement whose subtree references
+    ``_GUARD_LAG`` reads the lagged frontier — that step has already
+    retired, so the fetch is a free D2H, not a drain.  Blind spot
+    (documented in docs/STATIC_ANALYSIS.md): a prefetcher that reaches
+    the loop only as a function parameter is invisible; keep the
+    engine's builder idiom (assign from the constructor expression)."""
+    for scope in ctx.scopes():
+        walk_fn = (_own_body_walk if isinstance(
+            scope, (ast.FunctionDef, ast.AsyncFunctionDef))
+            else _top_scope_walk)
+        nodes = sorted(
+            (n for n in walk_fn(scope)
+             if isinstance(n, (ast.Assign, ast.NamedExpr, ast.For))),
+            key=lambda n: (n.lineno, n.col_offset))
+        loop_vars: set[str] = set()
+        step_loops: list[ast.For] = []
+        for node in nodes:
+            if isinstance(node, (ast.Assign, ast.NamedExpr)):
+                names = {name for _t, name in _assigned_names(node)}
+                if _has_step_source_call(node.value, ctx, loop_vars):
+                    loop_vars |= names
+                else:
+                    loop_vars -= names  # rebound to something else
+            elif _has_step_source_call(node.iter, ctx, loop_vars):
+                step_loops.append(node)
+        for loop in step_loops:
+            for stmt in loop.body:
+                lagged = any(isinstance(n, ast.Name)
+                             and n.id == _LAG_SENTINEL
+                             for n in ast.walk(stmt))
+                if lagged:
+                    continue
+                for node in ast.walk(stmt):
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.Lambda)):
+                        continue
+                    if not isinstance(node, ast.Call):
+                        continue
+                    qual = ctx.qual(node.func)
+                    if qual in _BLOCKING_FETCH_CALLS:
+                        yield ctx.finding(
+                            node, "blocking-call-in-step-loop",
+                            f"{qual}() inside the prefetched step loop "
+                            "blocks on an in-flight step result — the "
+                            "per-step sync the async dispatch pipeline "
+                            "exists to avoid; consume from a frontier "
+                            f"lagged {_LAG_SENTINEL} steps behind the "
+                            "dispatch (engine._LaggedMetrics), or "
+                            "suppress with justification")
+                    elif isinstance(node.func, ast.Attribute) and \
+                            node.func.attr in _BLOCKING_FETCH_METHODS:
+                        yield ctx.finding(
+                            node, "blocking-call-in-step-loop",
+                            f".{node.func.attr}() inside the prefetched "
+                            "step loop is a device→host sync on an "
+                            "in-flight result — it drains the dispatch "
+                            "pipeline every step; read the "
+                            f"{_LAG_SENTINEL}-lagged frontier instead, "
+                            "or suppress with justification")

@@ -8,14 +8,33 @@ that gap: the full ``{params, batch_stats, opt_state, step}`` bundle plus
 ``{epoch, best_top1, best_top5}`` metadata round-trips, enabling
 ``--resume`` after preemption (which matters far more on TPU pods).
 
-Async saves: Orbax's ``StandardCheckpointer`` stages (device→host) and
-finalizes in a background thread. ``save(..., block=False)`` returns as
-soon as staging is done — training overlaps the serialization of the
-per-epoch LAST checkpoint. Correctness rule: the metadata is stored
-INSIDE the Orbax pytree (scalar leaves), so it is atomic with the state
-under Orbax's rename — a kill at any moment leaves a directory whose
-meta always describes exactly the weights it holds. The JSON sidecar is
-advisory (human inspection only; restore reads the in-tree meta).
+Async saves — two generations of the idea live here:
+
+* ``save(..., block=False)`` (legacy): Orbax's ``StandardCheckpointer``
+  stages (device→host) and finalizes in a background thread; the commit
+  swap lands at the NEXT save/wait. Still used when the state is not
+  host-snapshotable (multi-host FSDP/TP shards).
+* ``save_async`` (the critical-path overlap path): the state is copied
+  to host on the main thread (the only blocking slice — milliseconds),
+  then a BACKGROUND COMMITTER THREAD serializes it (flat snapshot
+  format, collective-free), rotates ``keep_last_k``, writes the meta
+  sidecar, hashes the integrity manifest, and clears the in-progress
+  marker — while the step loop keeps dispatching. Only one commit is in
+  flight; the next ``save_async``/``save``/``wait_until_finished``
+  lands it first. The commit VERDICT is pod-agreed at that landing
+  point — at commit *completion*, not at snapshot time — so a one-host
+  failed commit can't split the pod's notion of "last good step"
+  (``poll_async``). A ``<name>.pending.json`` marker records the
+  in-progress generation; ``restore_resilient`` skips a live candidate
+  whose meta matches a dangling marker (killed mid-commit) without
+  probing it.
+
+Correctness rule (both paths): the live checkpoint is never the write
+target, and the metadata is atomic with the state (in-tree for Orbax,
+in ``snapshot.json`` for the async format) — a kill at any moment
+leaves directories whose meta describes exactly the weights they hold.
+The ``<name>_meta.json`` sidecar is advisory (fast inspection; restore
+reads the in-checkpoint meta).
 """
 
 from __future__ import annotations
@@ -24,6 +43,7 @@ import json
 import os
 import re
 import threading
+import time
 from typing import Any
 
 import jax
@@ -31,7 +51,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from imagent_tpu.resilience import faultinject, integrity
-from imagent_tpu.train import TrainState
+from imagent_tpu.train import TrainState, host_snapshot, snapshotable
 
 BEST = "best"
 LAST = "last"
@@ -56,8 +76,21 @@ _ckptr: ocp.StandardCheckpointer | None = None
 _pending_commit: tuple[str, str, dict, int] | None = None
 _manifest_thread: threading.Thread | None = None
 
+# ---- async snapshot-commit state (save_async / poll_async) ----
+# The committer thread exists on process 0 only (single fs writer); the
+# `_async_outstanding` flag is set on EVERY process at save_async time so
+# the verdict collective in poll_async is entered symmetrically.
+_commit_thread: threading.Thread | None = None
+_commit_result: dict | None = None
+_commit_started_at: float | None = None   # monotonic; watchdog monitor
+_async_outstanding = False
+_commit_windows: list[dict] = []          # wall-clock windows, drills
+_MAX_COMMIT_WINDOWS = 16
+
 _STAGING = ".staging"  # never restored; the in-flight write target
 _OLD = ".old"          # previous checkpoint during the commit swap
+_SNAPSHOT_JSON = "snapshot.json"  # async-format index + meta
+_SNAPSHOT_BIN = "snapshot.bin"    # async-format concatenated leaves
 # keep_last_k rotation: the previous live checkpoints survive as
 # name.1 (newest) .. name.K (oldest) — the "previous LAST" rungs of the
 # fallback restore chain (restore_resilient).
@@ -159,9 +192,11 @@ def _tear_file(root: str) -> None:
               f"({vsize} -> {vsize // 2} bytes)", flush=True)
 
 
-def _commit(ckpt_dir: str, name: str, meta: dict,
-            keep_last_k: int = 0) -> None:
-    """Swap the finalized staging checkpoint into the live name.
+def _commit_files(ckpt_dir: str, name: str, meta: dict,
+                  keep_last_k: int = 0,
+                  manifest_in_thread: bool = False) -> None:
+    """Process-0 LOCAL half of a commit: swap the finalized staging
+    checkpoint into the live name, rotate, write sidecars.
 
     The live checkpoint is NEVER the write target (a process killed
     mid-async-save must not destroy the last durable state — an Orbax
@@ -175,36 +210,77 @@ def _commit(ckpt_dir: str, name: str, meta: dict,
     ``name.1``, all handled by ``restore``. After the swap, a checksum
     manifest of the committed tree is written (``resilience/
     integrity.py``) so restore can verify the bytes it is about to
-    trust."""
+    trust; with ``manifest_in_thread`` (the async committer, already a
+    background thread) it is hashed inline instead of on a helper.
+
+    Fault points (``LAST`` commits only — the per-epoch cadence the
+    drills target, never BEST/preemption saves):
+
+    * ``ckpt.commit_fail`` — raises before any rename: the live
+      generation survives untouched and the caller records a failed
+      commit (the async path pod-agrees the failure at the next land).
+    * ``ckpt.slow_commit`` — sleeps ``secs`` (default 5) after the swap
+      + meta write but BEFORE the manifest and the pending-marker
+      removal: a kill mid-sleep leaves exactly the half-committed state
+      (complete-looking live dir, dangling marker) the marker-skip
+      restore path exists for.
+    """
     import shutil
 
-    if jax.process_index() == 0:
-        _join_manifest()  # the hash walks dirs the renames below touch
-        staging = os.path.join(ckpt_dir, name + _STAGING)
-        live = os.path.join(ckpt_dir, name)
-        old = os.path.join(ckpt_dir, name + _OLD)
-        if os.path.isdir(live):
-            if keep_last_k > 0:
-                _remove_checkpoint(ckpt_dir, f"{name}.{keep_last_k}")
-                for i in range(keep_last_k - 1, 0, -1):
-                    if os.path.isdir(os.path.join(ckpt_dir, f"{name}.{i}")):
-                        _shift_checkpoint(ckpt_dir, f"{name}.{i}",
-                                          f"{name}.{i + 1}")
-                _shift_checkpoint(ckpt_dir, name, f"{name}.1")
-            else:
-                # Clear .old only when a live checkpoint is about to
-                # replace it — if live is absent (recovering from a prior
-                # mid-commit crash), .old IS the only durable state and
-                # must survive until the new live lands.
-                shutil.rmtree(old, ignore_errors=True)
-                os.rename(live, old)
-        os.rename(staging, live)
-        if keep_last_k <= 0:
+    if name == LAST:
+        f = faultinject.fire("ckpt.commit_fail")
+        if f is not None:
+            raise RuntimeError("FAULT ckpt.commit_fail: injected commit "
+                               "failure (live checkpoint untouched)")
+    _join_manifest()  # the hash walks dirs the renames below touch
+    staging = os.path.join(ckpt_dir, name + _STAGING)
+    live = os.path.join(ckpt_dir, name)
+    old = os.path.join(ckpt_dir, name + _OLD)
+    if os.path.isdir(live):
+        if keep_last_k > 0:
+            _remove_checkpoint(ckpt_dir, f"{name}.{keep_last_k}")
+            for i in range(keep_last_k - 1, 0, -1):
+                if os.path.isdir(os.path.join(ckpt_dir, f"{name}.{i}")):
+                    _shift_checkpoint(ckpt_dir, f"{name}.{i}",
+                                      f"{name}.{i + 1}")
+            _shift_checkpoint(ckpt_dir, name, f"{name}.1")
+        else:
+            # Clear .old only when a live checkpoint is about to
+            # replace it — if live is absent (recovering from a prior
+            # mid-commit crash), .old IS the only durable state and
+            # must survive until the new live lands.
             shutil.rmtree(old, ignore_errors=True)
-        _write_meta(ckpt_dir, name, meta)
+            os.rename(live, old)
+    os.rename(staging, live)
+    if keep_last_k <= 0:
+        shutil.rmtree(old, ignore_errors=True)
+    _write_meta(ckpt_dir, name, meta)
+    if name == LAST:
+        f = faultinject.fire("ckpt.slow_commit")
+        if f is not None:
+            secs = float(f.get("secs", 5.0))
+            print(f"FAULT ckpt.slow_commit: sleeping {secs}s mid-commit",
+                  flush=True)
+            time.sleep(secs)
+    if manifest_in_thread:
+        try:
+            integrity.write_manifest(ckpt_dir, name)
+        except OSError as e:
+            print(f"WARNING: could not write checkpoint manifest for "
+                  f"{name}: {e}", flush=True)
+    else:
         _write_manifest_bg(ckpt_dir, name)
-        if faultinject.fire("torn-checkpoint") is not None:
-            _tear_file(live)
+    _clear_pending_marker(ckpt_dir, name)
+    if faultinject.fire("torn-checkpoint") is not None:
+        _tear_file(live)
+
+
+def _commit(ckpt_dir: str, name: str, meta: dict,
+            keep_last_k: int = 0) -> None:
+    """Commit with the cross-host barrier: process 0 does the file
+    work (``_commit_files``), everyone synchronizes after."""
+    if jax.process_index() == 0:
+        _commit_files(ckpt_dir, name, meta, keep_last_k)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(f"ckpt_commit_{name}")
@@ -217,13 +293,370 @@ def _land_pending() -> None:
         _pending_commit = None
 
 
-def wait_until_finished() -> None:
-    """Block until any in-flight async save is durable (committed to its
-    live name, meta sidecar written, integrity manifest hashed). Call
-    before reading a just-written checkpoint and at the end of a run."""
+# --------------------------------------------------------------------------
+# Async snapshot-commit path (save_async / poll_async)
+# --------------------------------------------------------------------------
+
+
+def _pending_marker_path(ckpt_dir: str, name: str) -> str:
+    return os.path.join(ckpt_dir, f"{name}.pending.json")
+
+
+def _write_pending_marker(ckpt_dir: str, name: str, meta: dict) -> None:
+    """Record the generation whose commit is about to start. Dangles
+    only when a crash interrupts the committer thread; the restore walk
+    uses it to skip the half-committed live candidate without probing
+    (``fallback_candidates``)."""
+    payload = {"name": name,
+               "generation": {"epoch": int(meta.get("epoch", -1)),
+                              "resume_step": int(meta.get("resume_step",
+                                                          0))},
+               "pid": os.getpid()}
+    path = _pending_marker_path(ckpt_dir, name)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def _read_pending_marker(ckpt_dir: str, name: str) -> dict | None:
+    try:
+        with open(_pending_marker_path(ckpt_dir, name)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _clear_pending_marker(ckpt_dir: str, name: str) -> None:
+    try:
+        os.remove(_pending_marker_path(ckpt_dir, name))
+    except OSError:
+        pass
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 & friends register here, not in np
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _write_snapshot(path: str, host_state, meta: dict) -> None:
+    """Serialize a host-numpy state tree to the flat snapshot format:
+    ``snapshot.bin`` (concatenated raw leaf bytes) + ``snapshot.json``
+    (keypath-indexed dtype/shape/offset table, plus the meta fields —
+    atomic with the weights, the same contract as the in-tree Orbax
+    meta). Pure local file I/O — safe on the committer thread with NO
+    collectives, which is what lets the commit overlap in-flight step
+    psums even on backends (gloo CPU) that abort on reordered
+    collectives."""
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)
+    os.makedirs(path)
+    leaves, _ = jax.tree_util.tree_flatten_with_path(host_state)
+    index, off = [], 0
+    with open(os.path.join(path, _SNAPSHOT_BIN), "wb") as f:
+        for keypath, leaf in leaves:
+            arr = np.asarray(leaf)
+            data = arr.tobytes()
+            index.append({"key": jax.tree_util.keystr(keypath),
+                          "dtype": np.dtype(arr.dtype).name,
+                          "shape": list(arr.shape),
+                          "offset": off, "nbytes": len(data)})
+            f.write(data)
+            off += len(data)
+        f.flush()
+        os.fsync(f.fileno())
+    payload = {
+        "version": 1, "leaves": index,
+        "meta": {k: (float(meta.get(k, d))
+                     if dtype is np.float64 else int(meta.get(k, d)))
+                 for k, dtype, d in _META_FIELDS},
+    }
+    with open(os.path.join(path, _SNAPSHOT_JSON), "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _reconcile_ema_buffers(state, ep: bool, eb: bool,
+                           tgt_ep: bool, tgt_eb: bool):
+    """Adapt a state restored with on-disk EMA presence ``(ep, eb)`` to
+    the target's ``(tgt_ep, tgt_eb)`` — buffers missing on disk
+    initialize from the restored live values; surplus ones drop."""
+    import jax.numpy as jnp
+    if tgt_ep and not ep:
+        print("NOTE: checkpoint has no EMA buffers (written with "
+              "--ema-decay off); initializing the average from the "
+              "restored params", flush=True)
+        state = state.replace(
+            ema_params=jax.tree.map(jnp.array, state.params))
+    elif ep and not tgt_ep:
+        print("NOTE: dropping the checkpoint's EMA buffers "
+              "(--ema-decay is off for this run)", flush=True)
+        state = state.replace(ema_params=None)
+    if tgt_eb and not eb:
+        print("NOTE: checkpoint has no EMA BatchNorm-stat buffers "
+              "(pre-round-4 EMA layout); initializing them from "
+              "the restored running stats", flush=True)
+        state = state.replace(
+            ema_batch_stats=jax.tree.map(jnp.array, state.batch_stats))
+    elif eb and not tgt_eb and hasattr(state, "ema_batch_stats"):
+        state = state.replace(ema_batch_stats=None)
+    return state
+
+
+def _restore_snapshot(path: str,
+                      target: TrainState) -> tuple[TrainState, dict]:
+    """Restore a flat-snapshot-format checkpoint (``save_async``'s
+    committer output). Leaves come back as host numpy arrays — the
+    engine re-places them onto the mesh (``place_state``), exactly as
+    with an Orbax restore. Shape/dtype/keyset mismatches raise (wrong
+    --arch / --num-classes), feeding the resilient fallback walk."""
+    with open(os.path.join(path, _SNAPSHOT_JSON)) as f:
+        spec = json.load(f)
+    by_key = {entry["key"]: entry for entry in spec["leaves"]}
+    ep = any(k.startswith(".ema_params") for k in by_key)
+    eb = any(k.startswith(".ema_batch_stats") for k in by_key)
+    tgt_ep = getattr(target, "ema_params", None) is not None
+    tgt_eb = getattr(target, "ema_batch_stats", None) is not None
+    adapted = target.replace(
+        ema_params=target.params if ep else None,
+        ema_batch_stats=target.batch_stats if eb else None)
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(adapted)
+    keys = [jax.tree_util.keystr(p) for p, _ in leaves]
+    if set(keys) != set(by_key):
+        missing = sorted(set(keys) - set(by_key))[:3]
+        surplus = sorted(set(by_key) - set(keys))[:3]
+        raise ValueError(
+            f"snapshot checkpoint at {path} does not match this state's "
+            f"tree (missing {missing}, surplus {surplus}) — "
+            "arch/--num-classes/optimizer likely differ from the run "
+            "that wrote it")
+    arrays = []
+    with open(os.path.join(path, _SNAPSHOT_BIN), "rb") as f:
+        for key, (_p, tgt_leaf) in zip(keys, leaves):
+            entry = by_key[key]
+            dtype = _dtype_from_name(entry["dtype"])
+            shape = tuple(entry["shape"])
+            tgt_shape = np.shape(tgt_leaf)
+            repad_to = None
+            if tgt_shape != shape:
+                # Cross-topology ZeRO-1: the flat momentum buffer is
+                # padded to a multiple of the data-axis size
+                # (parallel/zero.py), so a different dp gives a
+                # length-only 1-D mismatch — restore at the ON-DISK
+                # length and repad (both paddings are zeros beyond the
+                # parameter count, so the content carries exactly).
+                if (key == ".opt_state" and len(shape) == 1
+                        and len(tgt_shape) == 1):
+                    repad_to = int(tgt_shape[0])
+                else:
+                    raise ValueError(
+                        f"snapshot leaf {key} has shape {shape}, this "
+                        f"state expects {tgt_shape} (wrong --arch/"
+                        "--num-classes?)")
+            f.seek(entry["offset"])
+            buf = f.read(entry["nbytes"])
+            if len(buf) != entry["nbytes"]:
+                raise ValueError(f"snapshot leaf {key} is truncated "
+                                 f"({len(buf)}/{entry['nbytes']} bytes)")
+            arr = np.frombuffer(buf, dtype).reshape(shape)
+            if repad_to is not None:
+                out = np.zeros((repad_to,), dtype)
+                keep = min(repad_to, arr.shape[0])
+                out[:keep] = arr[:keep]
+                print(f"NOTE: repartitioned the ZeRO-1 momentum buffer "
+                      f"({arr.shape[0]} -> {repad_to} padded elements) "
+                      "for the new data-axis size", flush=True)
+                arr = out
+            arrays.append(arr)
+    state = jax.tree_util.tree_unflatten(treedef, arrays)
+    state = _reconcile_ema_buffers(state, ep, eb, tgt_ep, tgt_eb)
+    meta: dict[str, Any] = {k: d for k, _, d in _META_FIELDS}
+    meta.update(spec.get("meta", {}))
+    return state, meta
+
+
+def _commit_snapshot(ckpt_dir: str, name: str, host_state, meta: dict,
+                     keep_last_k: int) -> None:
+    """Committer-thread body (process 0): serialize the host snapshot
+    to staging, swap it live (rotation + meta + manifest, all inline),
+    clear the pending marker, record the verdict. On ANY failure the
+    staging dir and marker are cleaned up and the live generation is
+    left untouched — the pod's last good step stays the previous
+    generation, agreed at the next ``poll_async``."""
+    global _commit_result, _commit_started_at
+    import shutil
+
+    t0 = time.monotonic()
+    window = {"start": time.time(), "end": None, "ok": None}
+    staging = os.path.join(ckpt_dir, name + _STAGING)
+    try:
+        _write_snapshot(staging, host_state, meta)
+        _commit_files(ckpt_dir, name, meta, keep_last_k,
+                      manifest_in_thread=True)
+        result = {"ok": True, "error": ""}
+    except BaseException as e:  # verdict, not crash: the run decides
+        shutil.rmtree(staging, ignore_errors=True)
+        _clear_pending_marker(ckpt_dir, name)
+        result = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    result["secs"] = time.monotonic() - t0
+    result["name"] = name
+    window["end"] = time.time()
+    window["ok"] = result["ok"]
+    _commit_windows.append(window)
+    del _commit_windows[:-_MAX_COMMIT_WINDOWS]
+    _commit_result = result
+    # The monitor's wedge clock measures the committer's RUNTIME, not
+    # the verdict's time-in-flight: a commit that finished in seconds
+    # must not read as wedged during a long epoch just because the
+    # verdict lands at the next boundary. (No race with the next
+    # save_async: it joins this thread before re-arming the clock.)
+    _commit_started_at = None
+
+
+def poll_async(block: bool = False) -> dict | None:
+    """Land the in-flight async commit if it has completed (or wait for
+    it with ``block``). Returns the landed verdict dict ``{"ok", "secs",
+    "name", "error"}`` once per commit, else None.
+
+    Pod agreement happens HERE — at commit completion, not at snapshot
+    time: process 0 (the single filesystem writer) broadcasts its
+    verdict and every process adopts it at the same point in the step
+    stream, so a failed commit fails everywhere and "last good
+    generation" never splits. Collective discipline: the broadcast runs
+    only while ``_async_outstanding`` is set, a flag raised on EVERY
+    process by the (pod-synchronous) ``save_async`` call — so
+    participation is symmetric by construction. No-op (and
+    collective-free) when nothing is outstanding."""
+    global _commit_thread, _commit_result, _commit_started_at, \
+        _async_outstanding
+    if not _async_outstanding:
+        return None
+    result = None
+    if jax.process_index() == 0:
+        t = _commit_thread
+        if t is not None and (block or not t.is_alive()):
+            t.join()
+            result = _commit_result
+        code = 0.0 if result is None else (1.0 if result["ok"] else 2.0)
+        secs = 0.0 if result is None else float(result["secs"])
+    else:
+        code, secs = 0.0, 0.0
+    if jax.process_count() > 1:
+        # Non-zero processes' inputs are ignored by the broadcast; they
+        # block in the collective until process 0 (joining its thread
+        # under `block`) arrives with the authoritative verdict.
+        from jax.experimental import multihost_utils
+        out = multihost_utils.broadcast_one_to_all(
+            np.asarray([code, secs], np.float64))
+        code, secs = float(out[0]), float(out[1])
+    if code == 0.0:
+        return None  # still committing; try again at the next boundary
+    _async_outstanding = False
+    if jax.process_index() == 0:
+        _commit_thread = None
+        _commit_started_at = None
+        _commit_result = None
+    else:
+        result = {"ok": code == 1.0, "secs": secs, "name": LAST,
+                  "error": "" if code == 1.0 else "failed on process 0"}
+    if not result["ok"] and jax.process_index() == 0:
+        print(f"WARNING: async checkpoint commit FAILED "
+              f"({result['error']}); the previous generation remains "
+              "the pod-agreed last good checkpoint", flush=True)
+    return result
+
+
+def commit_stats() -> dict | None:
+    """Wall-clock window of the most recent async commit on THIS
+    process (``{"start", "end", "ok"}``, process 0 only) — drills
+    assert steps were dispatched inside it."""
+    return _commit_windows[-1] if _commit_windows else None
+
+
+def commit_windows() -> list[dict]:
+    """All recorded commit windows (newest last, bounded history) —
+    drills pick the injected-slow one out of a multi-commit run."""
+    return list(_commit_windows)
+
+
+def commit_monitor(deadline_secs: float):
+    """Watchdog monitor closure (``StepWatchdog.add_monitor``): reports
+    a wedged committer thread — one running past ``deadline_secs`` —
+    so a hung async commit (dead storage mount) gets the same stack
+    dump + checkpoint-and-exit treatment as a hung step."""
+    def check() -> str | None:
+        t0 = _commit_started_at
+        if t0 is not None and time.monotonic() - t0 > deadline_secs:
+            return (f"async checkpoint commit thread has been running "
+                    f"> {deadline_secs:.0f}s (wedged storage?)")
+        return None
+    return check
+
+
+def save_async(ckpt_dir: str, name: str, state: TrainState, meta: dict,
+               keep_last_k: int = 0) -> dict | None:
+    """Snapshot-then-commit asynchronous save. The ONLY blocking work on
+    the caller's thread is (a) landing any previous in-flight commit
+    (normally long done) and (b) the device→host snapshot copy; the
+    serialization, rotation, meta, and manifest hashing all run on a
+    background committer thread (process 0). Returns the landed verdict
+    of the PREVIOUS async commit, if one was still outstanding (the
+    engine attributes its duration to the ``ckpt_commit_async``
+    telemetry phase).
+
+    States that are not host-snapshotable (multi-host FSDP/TP shards)
+    fall back to the legacy Orbax ``save(..., block=False)`` path —
+    still overlapped, but committed at the next save/wait instead of by
+    the committer thread."""
+    global _commit_thread, _commit_started_at, _commit_result, \
+        _async_outstanding
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    landed = poll_async(block=True)  # only one commit in flight
+    # Land any legacy-path work too: the rotations must not interleave.
     _checkpointer().wait_until_finished()
     _land_pending()
     _join_manifest()
+    if not snapshotable(state):
+        print("NOTE: state is not host-snapshotable (multi-host sharded "
+              "leaves); async checkpoint falls back to the Orbax "
+              "deferred-commit path", flush=True)
+        save(ckpt_dir, name, state, meta, block=False,
+             keep_last_k=keep_last_k)
+        return landed
+    if jax.process_index() == 0:
+        snap = host_snapshot(state)  # the blocking slice
+        _write_pending_marker(ckpt_dir, name, meta)
+        _commit_result = None
+        _commit_started_at = time.monotonic()
+        _commit_thread = threading.Thread(
+            target=_commit_snapshot,
+            args=(ckpt_dir, name, snap, dict(meta), keep_last_k),
+            name=f"ckpt-commit-{name}", daemon=True)
+        _commit_thread.start()
+    _async_outstanding = True
+    return landed
+
+
+def wait_until_finished() -> dict | None:
+    """Block until any in-flight async save is durable (committed to its
+    live name, meta sidecar written, integrity manifest hashed) and its
+    verdict pod-agreed. Call before reading a just-written checkpoint,
+    at restore/rollback, and at the end of a run — the preemption exit
+    path reaches it via the blocking preemption save. Returns the
+    landed verdict if a commit was still in flight (the FINAL epoch's
+    LAST commit lands here — a failure must reach the caller, since
+    there is no next epoch to retry it)."""
+    landed = poll_async(block=True)
+    _checkpointer().wait_until_finished()
+    _land_pending()
+    _join_manifest()
+    return landed
 
 
 def save(ckpt_dir: str, name: str, state: TrainState, meta: dict,
@@ -243,7 +676,10 @@ def save(ckpt_dir: str, name: str, state: TrainState, meta: dict,
     staging = os.path.join(ckpt_dir, name + _STAGING)
     ckptr = _checkpointer()
     # Only one save may be in flight; landing the previous one also
-    # commits its staging dir and sidecar in the correct order.
+    # commits its staging dir and sidecar in the correct order. The
+    # async snapshot-commit path lands first (its rotations and this
+    # save's must not interleave).
+    poll_async(block=True)
     ckptr.wait_until_finished()
     _land_pending()
     # Hand Orbax the jax.Arrays as-is: it gathers sharded leaves itself
@@ -304,6 +740,9 @@ def restore(ckpt_dir: str, name: str,
               f"restoring the previous durable checkpoint {old}",
               flush=True)
         path = old
+    if os.path.isfile(os.path.join(path, _SNAPSHOT_JSON)):
+        # Flat snapshot format (the async committer's output).
+        return _restore_snapshot(path, target)
     ckptr = ocp.StandardCheckpointer()
 
     def _abstract(x):
@@ -347,27 +786,7 @@ def restore(ckpt_dir: str, name: str,
     def _reconcile_ema(state, ep: bool, eb: bool):
         """Adapt a state restored with on-disk presence (ep, eb) to the
         target's (tgt_ep, tgt_eb)."""
-        import jax.numpy as jnp
-        if tgt_ep and not ep:
-            print("NOTE: checkpoint has no EMA buffers (written with "
-                  "--ema-decay off); initializing the average from the "
-                  "restored params", flush=True)
-            state = state.replace(
-                ema_params=jax.tree.map(jnp.array, state.params))
-        elif ep and not tgt_ep:
-            print("NOTE: dropping the checkpoint's EMA buffers "
-                  "(--ema-decay is off for this run)", flush=True)
-            state = state.replace(ema_params=None)
-        if tgt_eb and not eb:
-            print("NOTE: checkpoint has no EMA BatchNorm-stat buffers "
-                  "(pre-round-4 EMA layout); initializing them from "
-                  "the restored running stats", flush=True)
-            state = state.replace(
-                ema_batch_stats=jax.tree.map(jnp.array,
-                                             state.batch_stats))
-        elif eb and not tgt_eb and hasattr(state, "ema_batch_stats"):
-            state = state.replace(ema_batch_stats=None)
-        return state
+        return _reconcile_ema_buffers(state, ep, eb, tgt_ep, tgt_eb)
 
     def _restore_state(abstract_state, meta_fields, combo=None):
         """Restore with the given state abstract. ``combo``: the
@@ -548,7 +967,16 @@ def fallback_candidates(ckpt_dir: str, name: str = LAST) -> list[str]:
     """The restore chain, newest-first: live ``name``, the rotated
     previous copies ``name.1``..``name.K`` (ascending = newest first),
     the legacy ``name.old`` crash-window slot, then ``best`` — a stale
-    model beats a dead run."""
+    model beats a dead run.
+
+    A dangling ``<name>.pending.json`` marker (a crash interrupted an
+    async commit) whose recorded generation matches the live
+    candidate's meta — or whose live meta sidecar never got written —
+    marks the live dir as HALF-COMMITTED: it is dropped from the chain
+    up front, without probing it, so the walk starts at the previous
+    durable generation. A marker whose generation does NOT match the
+    live meta means the crash hit before the swap — the live dir still
+    holds the previous (good) generation and stays in the chain."""
     rotated = []
     try:
         pat = re.compile(re.escape(name) + r"\.(\d+)$")
@@ -561,6 +989,21 @@ def fallback_candidates(ckpt_dir: str, name: str = LAST) -> list[str]:
     chain = [name] + [e for _, e in sorted(rotated)] + [name + _OLD]
     if name != BEST:
         chain.append(BEST)
+    marker = _read_pending_marker(ckpt_dir, name)
+    if marker is not None and os.path.isdir(os.path.join(ckpt_dir, name)):
+        gen = marker.get("generation", {})
+        sidecar_present = os.path.isfile(_meta_path(ckpt_dir, name))
+        live = _sidecar_meta(ckpt_dir, name)
+        half_committed = (not sidecar_present) or (
+            int(live.get("epoch", -1)) == int(gen.get("epoch", -2))
+            and int(live.get("resume_step", 0))
+            == int(gen.get("resume_step", -1)))
+        if half_committed:
+            print(f"NOTE: checkpoint '{name}' matches a dangling "
+                  "in-progress commit marker (crash mid-commit); "
+                  "skipping it without probing and walking from the "
+                  "previous durable generation", flush=True)
+            chain = chain[1:]
     return chain
 
 

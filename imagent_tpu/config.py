@@ -130,6 +130,14 @@ class Config:
     # runs skip the first-step compile (~minutes for big models).
     compile_cache: str = ""
     check_nans: bool = False  # debug flag (SURVEY §5 sanitizers)
+    # Asynchronous per-epoch LAST checkpointing (checkpoint.save_async):
+    # the step loop blocks only for the device→host snapshot;
+    # serialization + rotation + manifest hashing run on a background
+    # committer thread whose verdict is pod-agreed at the next epoch
+    # boundary. --no-async-ckpt restores the fully synchronous save —
+    # the bench-smoke baseline the telemetry regression compares
+    # against.
+    async_ckpt: bool = True
 
     # ---- resilience (imagent_tpu/resilience/) ----
     # Non-finite step guard: bad steps are always skipped in-graph
@@ -327,6 +335,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compile-cache", type=str, default=c.compile_cache,
                    help="persistent XLA compilation cache directory")
     p.add_argument("--check-nans", action="store_true", default=False)
+    p.add_argument("--async-ckpt", dest="async_ckpt",
+                   action="store_true", default=True,
+                   help="commit per-epoch LAST checkpoints on a "
+                        "background thread (snapshot-then-commit; "
+                        "the default)")
+    p.add_argument("--no-async-ckpt", dest="async_ckpt",
+                   action="store_false",
+                   help="fully synchronous checkpoint saves (the "
+                        "step loop stalls for serialize+commit+"
+                        "manifest)")
     # Resilience subsystem.
     p.add_argument("--max-bad-steps", type=int, default=c.max_bad_steps,
                    help="consecutive non-finite (skipped) steps before "

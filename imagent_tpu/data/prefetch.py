@@ -127,6 +127,19 @@ def iter_with_producer(produce: Callable, maxsize: int,
         t.join(timeout=5.0)
 
 
+def _stage_batch(mesh, batch, with_mask: bool,
+                 stats: PrefetchStats | None):
+    """One ``data.pipeline.Batch`` → global device arrays (+ stats)."""
+    if stats is not None:
+        stats.bytes_staged += (
+            batch.images.nbytes + batch.labels.nbytes
+            + (batch.mask.nbytes if with_mask else 0))
+        stats.batches += 1
+    if with_mask:
+        return shard_batch(mesh, batch.images, batch.labels, batch.mask)
+    return shard_batch(mesh, batch.images, batch.labels)
+
+
 def device_prefetch(mesh, batch_iter, with_mask: bool = False,
                     depth: int = 2,
                     stats: PrefetchStats | None = None) -> Iterator[tuple]:
@@ -137,21 +150,17 @@ def device_prefetch(mesh, batch_iter, with_mask: bool = False,
     ``(images, labels)`` for the train step, or with ``with_mask``
     ``(images, labels, mask)`` for the eval step. ``stats`` accumulates
     host-blocked time and staged host→device bytes for the epoch.
+
+    Lazy (generator semantics): the producer thread starts at the first
+    ``next()`` and unwinds via ``GeneratorExit``. The engine's epoch
+    loop uses :class:`Prefetcher` instead — same item contract, but the
+    producer starts EAGERLY so an epoch boundary can warm the next
+    epoch's staging queue while the current tail is still in flight.
     """
 
     def produce(put):
         for batch in batch_iter:
-            if stats is not None:
-                stats.bytes_staged += (
-                    batch.images.nbytes + batch.labels.nbytes
-                    + (batch.mask.nbytes if with_mask else 0))
-                stats.batches += 1
-            if with_mask:
-                item = shard_batch(mesh, batch.images, batch.labels,
-                                   batch.mask)
-            else:
-                item = shard_batch(mesh, batch.images, batch.labels)
-            if not put(item):
+            if not put(_stage_batch(mesh, batch, with_mask, stats)):
                 return
 
     try:
@@ -162,3 +171,98 @@ def device_prefetch(mesh, batch_iter, with_mask: bool = False,
         close = getattr(batch_iter, "close", None)
         if close is not None:
             close()
+
+
+class Prefetcher:
+    """Eagerly-started device prefetch (drain-free epoch boundaries).
+
+    Same item contract as :func:`device_prefetch`, but the producer
+    thread starts in ``__init__`` — so constructing one for epoch N+1
+    at the end of epoch N overlaps the next epoch's decode + staging
+    with the current epoch's metric-tail drain, eval, and checkpoint
+    phases, and the first step of the new epoch finds its batch already
+    staged instead of paying a cold decode.
+
+    Not a generator: an abandoned instance has no ``GeneratorExit``
+    unwind, so ``close()`` MUST be called when the iterator is not run
+    to exhaustion (early preemption break, rollback discarding a warmed
+    handle). ``close()`` is idempotent and also closes the source
+    ``batch_iter``; ``__del__`` is a best-effort backstop.
+    """
+
+    def __init__(self, mesh, batch_iter, with_mask: bool = False,
+                 depth: int = 2, stats: PrefetchStats | None = None):
+        self.stats = stats if stats is not None else PrefetchStats()
+        self._batch_iter = batch_iter
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._end = object()
+        self._done = False
+        self._closed = False
+
+        def _put(item) -> bool:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def runner():
+            try:
+                for batch in batch_iter:
+                    if not _put(_stage_batch(mesh, batch, with_mask,
+                                             self.stats)):
+                        return
+                _put(self._end)
+            except BaseException as e:  # propagate to the consumer
+                _put(e)
+
+        self._thread = threading.Thread(
+            target=runner, name="device-prefetch", daemon=True)
+        self._thread.start()
+
+    def __iter__(self) -> "Prefetcher":
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        t0 = time.perf_counter()
+        item = self._q.get()
+        waited = time.perf_counter() - t0
+        self.stats.wait_s += waited
+        if waited > self.stats.max_wait_s:
+            self.stats.max_wait_s = waited
+        if item is self._end:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._done = True
+            raise item
+        return item
+
+    def close(self) -> None:
+        """Release the producer thread and the staged batches it holds,
+        then close the source iterator (decode pools unwind)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._done = True
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+        close = getattr(self._batch_iter, "close", None)
+        if close is not None:
+            close()
+
+    def __del__(self):  # backstop only; call close() explicitly
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -35,7 +35,9 @@ from imagent_tpu import cluster
 from imagent_tpu.config import Config
 from imagent_tpu.data import make_loaders
 from imagent_tpu.data.pipeline import WIRE_DTYPES
-from imagent_tpu.data.prefetch import PrefetchStats, device_prefetch
+from imagent_tpu.data.prefetch import (
+    Prefetcher, PrefetchStats, device_prefetch,
+)
 from imagent_tpu.models import create_model
 from imagent_tpu.resilience import faultinject
 from imagent_tpu.resilience.watchdog import StepWatchdog
@@ -104,21 +106,80 @@ class PreemptionGuard:
         return self.requested
 
 
-def _finalize(metric_buf: list) -> dict:
-    """Sum per-step [loss_sum, top1, top5, n] vectors → epoch averages.
-    One host sync per epoch (not per step). ``bad_steps`` counts the
-    all-zero vectors the non-finite step guard emits for skipped
-    updates (``n == 0`` — impossible for a real step; train.py)."""
-    if not metric_buf:
-        return {"loss": 0.0, "top1": 0.0, "top5": 0.0, "n": 0,
-                "bad_steps": 0}
-    arr = np.stack([np.asarray(m) for m in metric_buf])
-    total = arr.sum(axis=0)
-    loss_sum, c1, c5, n = [float(x) for x in total]
-    n = max(n, 1.0)
-    return {"loss": loss_sum / n, "top1": c1 * 100.0 / n,
-            "top5": c5 * 100.0 / n, "n": int(n),
-            "bad_steps": int((arr[:, 3] == 0).sum())}
+_GUARD_LAG = 2  # steps behind the dispatch the lagged frontier reads
+
+
+class _LaggedMetrics:
+    """The metric frontier: per-step [loss_sum, top1, top5, n] vectors
+    consumed ``lag`` steps BEHIND the dispatch.
+
+    This is what makes the epoch boundary drain-free: every fetch
+    (``np.asarray``) targets a vector whose step has (almost always)
+    already retired — a cheap D2H of 16 ready bytes, never a pipeline
+    drain — and by the time the epoch ends only the ≤ ``lag``-step tail
+    remains unconsumed, so ``drain()`` waits on the in-flight frontier
+    tail, not on transferring a whole epoch of buffered vectors. The
+    non-finite step guard (``bad``/``tripped``) and the ``--log-every``
+    readout (``last``) ride the same consumed stream, so the step loop
+    body itself contains NO blocking call on an in-flight result (the
+    invariant the ``blocking-call-in-step-loop`` jaxlint rule pins).
+    """
+
+    def __init__(self, lag: int = _GUARD_LAG, max_bad: int = 0,
+                 is_master: bool = False):
+        self._pending: collections.deque = collections.deque()
+        self.lag = lag
+        self.max_bad = max_bad
+        self.is_master = is_master
+        self._sums = np.zeros(4, np.float64)
+        self.steps = 0
+        self.bad_steps = 0
+        self.consec_bad = 0
+        self.tripped = False
+        self.last: np.ndarray | None = None  # newest consumed vector
+
+    def _consume(self, m) -> None:
+        v = np.asarray(m)
+        self._sums += v
+        self.steps += 1
+        self.last = v
+        if v[3] == 0:  # n == 0: the in-graph guard skipped this update
+            self.bad_steps += 1
+            self.consec_bad += 1
+            if self.is_master and self.max_bad:
+                # With --max-bad-steps off there is no rollback to
+                # warn about per step; bad_steps still reach the epoch
+                # summary.
+                print(f"WARNING: non-finite step skipped "
+                      f"({self.consec_bad} consecutive; rollback at "
+                      f"{self.max_bad})", flush=True)
+            if self.max_bad and self.consec_bad >= self.max_bad:
+                self.tripped = True
+        else:
+            self.consec_bad = 0
+
+    def push(self, m) -> None:
+        """Record a just-dispatched step's metric vector; consumes the
+        one now ``lag`` steps old."""
+        self._pending.append(m)
+        if len(self._pending) > self.lag:
+            self._consume(self._pending.popleft())
+
+    def drain(self) -> bool:
+        """Consume the ≤ ``lag``-step tail (the only boundary wait);
+        True if the consecutive-bad budget tripped."""
+        while self._pending:
+            self._consume(self._pending.popleft())
+        return self.tripped
+
+    def summary(self) -> dict:
+        """Epoch averages over everything consumed so far."""
+        loss_sum, c1, c5, n = [float(x) for x in self._sums]
+        n = max(n, 1.0)
+        return {"loss": loss_sum / n, "top1": c1 * 100.0 / n,
+                "top5": c5 * 100.0 / n,
+                "n": int(n) if self.steps else 0,
+                "bad_steps": self.bad_steps}
 
 
 def _stop_agreed(stop_check, step_i: int) -> bool:
@@ -158,35 +219,39 @@ def _skip_batches(it, n: int):
             close()
 
 
-_GUARD_LAG = 2  # steps behind the dispatch the guard reads verdicts
-
-
 def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                     loader, epoch: int, lr: float, is_master: bool,
                     stop_check=None, start_step: int = 0,
                     watchdog: StepWatchdog | None = None,
                     telem: TelemetrySession | None = None,
-                    ) -> tuple[TrainState, dict, float, int, bool]:
+                    prefetch: Prefetcher | None = None,
+                    ) -> tuple[TrainState, dict, float, int, bool,
+                               Prefetcher | None]:
     """One training epoch (reference ``train()``, ``imagenet.py:97-151``).
 
     ``start_step``: skip the first N batches — resuming an epoch that a
     preemption interrupted after N optimizer steps (the loader's order
     is deterministic per (seed, epoch), so the skipped batches are
     exactly the ones already applied).
-    Returns ``(state, metrics, seconds, interrupted_at, rollback)``
-    where ``interrupted_at`` is -1 for a completed epoch, else the
-    number of optimizer steps applied when the stop fired; ``rollback``
-    is True when ``cfg.max_bad_steps`` consecutive non-finite steps
-    were observed and the caller should restore the last good
-    checkpoint (``run``'s rollback loop).
+    Returns ``(state, metrics, seconds, interrupted_at, rollback,
+    warm)`` where ``interrupted_at`` is -1 for a completed epoch, else
+    the number of optimizer steps applied when the stop fired;
+    ``rollback`` is True when ``cfg.max_bad_steps`` consecutive
+    non-finite steps were observed and the caller should restore the
+    last good checkpoint (``run``'s rollback loop); ``warm`` is the
+    next epoch's already-running ``Prefetcher`` (see below), or None.
 
-    Bad-step detection rides the per-step metric vector (an all-zero
-    vector, train.py) and is read ``_GUARD_LAG`` steps behind the
-    dispatch: the inspected step has (almost always) already completed,
-    so the read is a cheap D2H of 16 ready bytes, not a pipeline drain
-    — step dispatch stays async. The verdicts are replicated arrays, so
-    every host counts the same sequence and agrees on the rollback
-    decision without any extra collective.
+    Drain-free boundary discipline: metric vectors are consumed by a
+    ``_LaggedMetrics`` frontier ``_GUARD_LAG`` steps behind the
+    dispatch — each read is a cheap D2H of 16 ready bytes, never a
+    pipeline drain — so the epoch-end ``drain()`` waits only on the
+    ≤ 2-step in-flight tail, and BEFORE that wait the next epoch's
+    producer is started (``warm``): decode + H2D staging for epoch N+1
+    overlap epoch N's tail drain, eval, and checkpoint. The bad-step
+    verdicts ride the same replicated vectors, so every host counts the
+    same sequence and agrees on the rollback decision without any
+    extra collective. ``prefetch``: a warm handle from the PREVIOUS
+    boundary (mutually exclusive with ``start_step`` skipping).
 
     ``telem`` (telemetry.TelemetrySession): per-step instrumentation is
     two host timestamps around the dispatch (goodput attribution +
@@ -195,8 +260,6 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
     """
     t0 = time.time()
     data_time = AverageMeter("data")
-    stats = PrefetchStats()
-    metric_buf = []
     # Place the epoch's LR on the mesh ONCE, not per step: an
     # uncommitted numpy scalar handed to the jitted step is device_put
     # onto the replicated sharding at EVERY dispatch, and on multi-host
@@ -210,43 +273,30 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
         np.asarray(lr, np.float32))
     interrupted_at = -1
     steps_done = start_step
-    max_bad = max(cfg.max_bad_steps, 0)
-    pending: collections.deque = collections.deque()
-    consec_bad = 0
+    acc = _LaggedMetrics(max_bad=max(cfg.max_bad_steps, 0),
+                         is_master=is_master)
     rollback = False
 
-    def _observe_lagged(drain: bool = False) -> bool:
-        """Pop verdicts that are ``_GUARD_LAG`` steps old (all of them
-        when ``drain``); True once the consecutive-bad budget is hit."""
-        nonlocal consec_bad
-        while pending and (drain or len(pending) > _GUARD_LAG):
-            m = np.asarray(pending.popleft())
-            if m[3] == 0:
-                consec_bad += 1
-                if is_master:
-                    print(f"WARNING: non-finite step skipped "
-                          f"({consec_bad} consecutive; rollback at "
-                          f"{max_bad})", flush=True)
-                if consec_bad >= max_bad:
-                    return True
-            else:
-                consec_bad = 0
-        return False
-
-    it = loader.epoch(epoch)
-    if start_step:
-        # NOT itertools.islice: islice has no close(), which would sever
-        # device_prefetch's deterministic unwind of the loader's decode
-        # thread exactly on the resumed-then-interrupted-again path.
-        it = _skip_batches(it, start_step)
+    if prefetch is not None:
+        assert start_step == 0, "warm prefetch cannot skip batches"
+        prefetch_iter = prefetch
+    else:
+        it = loader.epoch(epoch)
+        if start_step:
+            # NOT itertools.islice: islice has no close(), which would
+            # sever the prefetcher's deterministic unwind of the
+            # loader's decode thread exactly on the
+            # resumed-then-interrupted-again path.
+            it = _skip_batches(it, start_step)
+        prefetch_iter = Prefetcher(mesh, it, depth=cfg.prefetch_depth)
+    stats = prefetch_iter.stats
     if watchdog is not None:
         watchdog.arm()
     try:
         t_fetch = time.time()
         # Batches arrive as device arrays staged ahead (H2D overlapped
         # with the running step, data/prefetch.py; --prefetch-depth).
-        for i, arrays in enumerate(device_prefetch(
-                mesh, it, depth=cfg.prefetch_depth, stats=stats)):
+        for i, arrays in enumerate(prefetch_iter):
             step_i = start_step + i
             if _stop_agreed(stop_check, step_i):
                 interrupted_at = steps_done
@@ -276,42 +326,57 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                 # step and seconds on a compiling one — the accountant
                 # splits compile from dispatch on that gap.
                 telem.record_dispatch(time.perf_counter() - t_dispatch)
-            metric_buf.append(metrics)
+            # The lagged frontier consumes the vector from _GUARD_LAG
+            # steps ago (already retired — a free D2H, not a drain) and
+            # carries the guard + log readout; NOTHING in this loop
+            # body blocks on an in-flight result
+            # (blocking-call-in-step-loop lint invariant).
+            acc.push(metrics)
             steps_done += 1
-            if max_bad:
-                pending.append(metrics)
-                if _observe_lagged():
-                    rollback = True
-                    break
+            if acc.tripped:
+                rollback = True
+                break
             if watchdog is not None:
                 watchdog.beat()
             if is_master and cfg.log_every \
-                    and (step_i + 1) % cfg.log_every == 0:
-                # Log from a metric _GUARD_LAG steps behind the dispatch
-                # frontier: that step has (almost always) already
-                # retired, so this is a cheap D2H of ready bytes — not a
-                # drain of the in-flight pipeline, which is what
-                # fetching THIS step's vector would force. The printed
-                # loss therefore lags the step counter by <= _GUARD_LAG
-                # steps (harmless for progress monitoring).
-                m = np.asarray(
-                    metric_buf[max(0, len(metric_buf) - 1 - _GUARD_LAG)])
+                    and (step_i + 1) % cfg.log_every == 0 \
+                    and acc.last is not None:
+                # The printed loss lags the step counter by
+                # <= _GUARD_LAG steps (harmless for monitoring).
+                m = acc.last
                 print(f"  epoch {epoch + 1} step {step_i + 1}/"
                       f"{loader.steps_per_epoch} loss "
                       f"{m[0] / max(m[3], 1):.4f} "
                       f"data_time {data_time.avg:.3f}s",
                       flush=True)
             t_fetch = time.time()
-        if max_bad and not rollback and interrupted_at < 0:
-            rollback = _observe_lagged(drain=True)
     finally:
         if watchdog is not None:
             watchdog.disarm()
+        prefetch_iter.close()  # eager iterator: no GeneratorExit unwind
+    # Warm the NEXT epoch's staging queue before draining this epoch's
+    # metric tail: decode + H2D for epoch N+1 overlap the tail drain
+    # and the eval/checkpoint phases at the boundary (drain-free epoch
+    # boundary). Skipped on preemption (the run is exiting); discarded
+    # below if the tail drain trips a rollback.
+    warm: Prefetcher | None = None
+    if (interrupted_at < 0 and not rollback
+            and epoch + 1 < cfg.epochs):
+        warm = Prefetcher(mesh, loader.epoch(epoch + 1),
+                          depth=cfg.prefetch_depth)
     t_drain = time.perf_counter()
-    epoch_metrics = _finalize(metric_buf)  # the only mandatory sync point
+    # Drain the ≤ _GUARD_LAG-step in-flight tail (not a sync). A trip
+    # discovered here counts only for a completed epoch — a preemption
+    # exit keeps the interrupted-checkpoint path.
+    if acc.drain() and interrupted_at < 0:
+        rollback = True
+        if warm is not None:
+            warm.close()
+            warm = None
+    epoch_metrics = acc.summary()
     if telem is not None:
-        # The finalize sync is the device draining the dispatched step
-        # frontier — the device-side tail of useful training work.
+        # The drain wait is the device retiring the dispatched frontier
+        # tail — the device-side tail of useful training work.
         telem.phase("step_drain", time.perf_counter() - t_drain)
         telem.absorb_input(stats)
         telem.count("quarantined",
@@ -322,7 +387,8 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
     # the epoch summary alone, no profiler trace needed.
     epoch_metrics["host_blocked_s"] = round(stats.wait_s, 3)
     epoch_metrics["h2d_bytes"] = int(stats.bytes_staged)
-    return state, epoch_metrics, time.time() - t0, interrupted_at, rollback
+    return (state, epoch_metrics, time.time() - t0, interrupted_at,
+            rollback, warm)
 
 
 def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
@@ -342,12 +408,18 @@ def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
             state = state.replace(batch_stats=state.ema_batch_stats)
     t0 = time.time()
     stats = PrefetchStats()
-    metric_buf = []
+    # Pipelined eval: every shard is dispatched before any metric
+    # vector is waited on — the lagged frontier (mirroring the train
+    # guard's _GUARD_LAG) fetches only already-retired vectors while
+    # later shards are still dispatching, so the fetch cost hides
+    # under the eval compute instead of serializing after it.
+    acc = _LaggedMetrics()
     for images, labels, mask in device_prefetch(
             mesh, loader.epoch(epoch), with_mask=True,
             depth=cfg.prefetch_depth, stats=stats):
-        metric_buf.append(eval_step(state, images, labels, mask))
-    metrics = _finalize(metric_buf)
+        acc.push(eval_step(state, images, labels, mask))
+    acc.drain()
+    metrics = acc.summary()
     metrics["host_blocked_s"] = round(stats.wait_s, 3)
     metrics["h2d_bytes"] = int(stats.bytes_staged)
     if telem is not None:
@@ -870,7 +942,8 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
                 "best_epoch": start_epoch - 1,
                 "total_minutes": (time.time() - run_t0) / 60.0,
                 "final_train": train_m, "final_val": val_m,
-                "preempted": False, "rollbacks": 0}
+                "preempted": False, "rollbacks": 0,
+                "ckpt_commit_failures": 0}
 
     # Telemetry (imagent_tpu/telemetry): goodput phases, step-time
     # percentiles, pod aggregation + straggler flags — TB scalars and
@@ -895,17 +968,51 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
             telem.count("watchdog_fired")
         telem.epoch_end(ep, tm, interrupted=interrupted)
 
+    ckpt_commit_failures = 0  # pod-agreed failed async commits
+
+    def _absorb_commit(landed: dict | None) -> None:
+        """Attribute a landed async-commit verdict: its duration moves
+        to the overlapped ``ckpt_commit_async`` phase (work hidden
+        behind compute, NOT part of the wall partition); a pod-agreed
+        failure is counted — the previous generation silently remains
+        the last good checkpoint and the next epoch's save retries."""
+        nonlocal ckpt_commit_failures
+        if landed is None:
+            return
+        if landed["ok"]:
+            telem.overlap("ckpt_commit_async", landed["secs"])
+            if is_master:
+                print(f"async checkpoint '{landed['name']}' committed "
+                      f"in {landed['secs']:.2f}s (overlapped with "
+                      "training)", flush=True)
+        else:
+            ckpt_commit_failures += 1
+            telem.count("ckpt_commit_failed")
+
+    if watchdog is not None and cfg.async_ckpt and cfg.save_model:
+        # A wedged committer thread (dead storage mount) gets the same
+        # stack-dump + checkpoint-and-exit + hard-exit escalation as a
+        # hung step (resilience/watchdog.py::add_monitor).
+        watchdog.add_monitor(ckpt_lib.commit_monitor(
+            max(4.0 * cfg.watchdog_secs, 60.0)))
+
     rollbacks = 0        # total, reported in the summary
     rollback_streak = 0  # consecutive incidents — the give-up budget
     epoch = start_epoch
+    warm = None  # next epoch's pre-started input pipeline
     while epoch < cfg.epochs:
         lr = lr_for_epoch(cfg, epoch)
         telem.epoch_begin()
-        state, train_m, train_t, interrupted_at, want_rollback = \
-            train_one_epoch(
-                cfg, mesh, train_step, state, train_loader, epoch, lr,
-                is_master, stop_check, resume_step, watchdog, telem)
+        (state, train_m, train_t, interrupted_at, want_rollback,
+         warm) = train_one_epoch(
+            cfg, mesh, train_step, state, train_loader, epoch, lr,
+            is_master, stop_check, resume_step, watchdog, telem,
+            prefetch=warm)
         resume_step = 0  # only the first resumed epoch skips batches
+        # Land the previous epoch's async checkpoint commit if it has
+        # completed (non-blocking; the verdict is pod-agreed HERE, at
+        # commit completion — checkpoint.poll_async).
+        _absorb_commit(ckpt_lib.poll_async())
         if not want_rollback:
             # An epoch got through without tripping the guard: any
             # earlier incident was genuinely transient. The give-up
@@ -1003,13 +1110,28 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
                     "best_top5": best_top5, "best_epoch": best_epoch,
                     **topo_meta})
         if cfg.save_model:
-            # Async: the next epoch trains while LAST serializes.
-            ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.LAST, state, {
-                "epoch": epoch, "best_top1": best_top1,
-                "best_top5": best_top5, "best_epoch": best_epoch,
-                **topo_meta}, block=False, keep_last_k=cfg.keep_last_k)
-        # The blocking slice only: staging for the async LAST (its
-        # finalize overlaps the next epoch by design) plus any BEST
+            last_meta = {"epoch": epoch, "best_top1": best_top1,
+                         "best_top5": best_top5, "best_epoch": best_epoch,
+                         **topo_meta}
+            if cfg.async_ckpt:
+                # Snapshot-then-commit: the only blocking slice is the
+                # device→host copy; serialization + rotation + manifest
+                # hashing run on the committer thread while the next
+                # epoch trains (checkpoint.save_async). If the PREVIOUS
+                # commit was somehow still in flight, landing it blocks
+                # here and its verdict is returned.
+                _absorb_commit(ckpt_lib.save_async(
+                    cfg.ckpt_dir, ckpt_lib.LAST, state, last_meta,
+                    keep_last_k=cfg.keep_last_k))
+            else:
+                # --no-async-ckpt: the fully synchronous baseline
+                # (bench-smoke's reference point) — the loop stalls for
+                # the whole serialize + commit + manifest.
+                ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.LAST, state,
+                              last_meta, block=True,
+                              keep_last_k=cfg.keep_last_k)
+        # The blocking slice only: the host snapshot for the async LAST
+        # (its commit overlaps the next epoch by design) plus any BEST
         # save — the wall time checkpointing actually cost this epoch.
         telem.phase("checkpoint", time.perf_counter() - t_ck)
         if is_master and train_m.get("bad_steps"):
@@ -1021,7 +1143,10 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
         _end_telemetry_epoch(epoch, train_m)
         epoch += 1
 
-    ckpt_lib.wait_until_finished()  # land any in-flight async save
+    # Land any in-flight async save — the final epoch's LAST commit
+    # lands HERE, so its verdict (a failure has no next-epoch retry)
+    # must be absorbed, not dropped.
+    _absorb_commit(ckpt_lib.wait_until_finished())
     if cfg.profile and is_master:
         jax.profiler.stop_trace()
     if not preempted:
@@ -1034,9 +1159,11 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
     summary = {"best_top1": best_top1, "best_top5": best_top5,
                "best_epoch": best_epoch, "total_minutes": total_min,
                "final_train": train_m, "final_val": val_m,
-               "preempted": preempted, "rollbacks": rollbacks}
+               "preempted": preempted, "rollbacks": rollbacks,
+               "ckpt_commit_failures": ckpt_commit_failures}
     telem.run_end({"best_top1": best_top1, "best_epoch": best_epoch,
                    "total_minutes": round(total_min, 3),
-                   "preempted": preempted, "rollbacks": rollbacks})
+                   "preempted": preempted, "rollbacks": rollbacks,
+                   "ckpt_commit_failures": ckpt_commit_failures})
     logger.close()
     return summary

@@ -35,6 +35,15 @@ Registered fault points (grep for ``faultinject.fire``):
 * ``sigterm`` (engine): calls ``os.kill(os.getpid(), SIGTERM)`` before
   a step — drives the PreemptionGuard checkpoint-and-exit path without
   an external killer.
+* ``ckpt.slow_commit`` (checkpoint, LAST commits only): sleeps ``secs``
+  (default 5) inside the commit, after the swap + meta write but before
+  the manifest and pending-marker removal — drives the async-commit
+  overlap drills (steps must keep dispatching) and, with a mid-sleep
+  kill, the marker-based half-committed-candidate skip at restore.
+* ``ckpt.commit_fail`` (checkpoint, LAST commits only): raises before
+  any rename — the live generation survives untouched and the async
+  path pod-agrees the failed verdict at the next landing point instead
+  of hanging or splitting the pod.
 
 Cost discipline: when nothing is configured, ``fire`` is one falsy
 check on a module dict — safe to call per step / per file in hot

@@ -77,11 +77,26 @@ class StepWatchdog:
         self._armed = False
         self._deadline_at: float | None = None  # None = not counting
         self._escalate_at: float | None = None
+        self._monitors: list = []  # aux health checks (async commit)
+        self._monitor_down: set[int] = set()  # fired-once bookkeeping
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._watch, name="step-watchdog", daemon=True)
         self._thread.start()
+
+    def add_monitor(self, check) -> None:
+        """Register an auxiliary health check: a zero-arg callable
+        returning None while healthy, or a description string when its
+        subsystem is wedged. Polled on the watchdog cadence REGARDLESS
+        of the armed window (the checkpoint committer thread runs
+        precisely during the disarmed phases). A wedged monitor gets
+        the step-stall treatment: stack dump, ``fired`` raised (the
+        engine's checkpoint-and-exit stop path), and the hard-exit
+        escalation if the main thread never reacts — a commit wedged on
+        dead storage must requeue the job, not outlive the walltime."""
+        with self._lock:
+            self._monitors.append(check)
 
     def arm(self) -> None:
         """Start a monitored window; the countdown begins at the first
@@ -114,6 +129,7 @@ class StepWatchdog:
         poll = min(max(self.deadline / 4.0, 0.05), 1.0)
         while not self._stop.wait(poll):
             escalate = False
+            monitor_msg = None
             with self._lock:
                 now = time.monotonic()
                 expired = (self._deadline_at is not None
@@ -127,11 +143,37 @@ class StepWatchdog:
                 elif (self._escalate_at is not None
                         and now > self._escalate_at):
                     escalate = True
+                for i, check in enumerate(self._monitors):
+                    try:
+                        desc = check()
+                    except Exception:
+                        desc = None
+                    if desc is None:
+                        self._monitor_down.discard(i)
+                        continue
+                    if i not in self._monitor_down:
+                        # Dump/flag once per incident; recovery re-arms.
+                        self._monitor_down.add(i)
+                        monitor_msg = desc
+                    self.fired = True
+                    if self._escalate_at is None:
+                        # Keep the hard-exit timer armed for as long as
+                        # the monitor is down: beat() clears it on step
+                        # progress, but steps progressing does NOT mean
+                        # the wedged commit recovered — and the clean
+                        # exit path will eventually block joining it.
+                        self._escalate_at = now + max(
+                            2.0 * self.deadline, 60.0)
             out = self._out if self._out is not None else sys.stderr
             if expired:
                 print(f"WATCHDOG: no train step completed within "
                       f"{self.deadline:.1f}s — dumping stacks and "
                       f"requesting checkpoint-and-exit",
+                      file=out, flush=True)
+                dump_all_stacks(self._out)
+            if monitor_msg is not None:
+                print(f"WATCHDOG: {monitor_msg} — dumping stacks and "
+                      "requesting checkpoint-and-exit",
                       file=out, flush=True)
                 dump_all_stacks(self._out)
             if escalate:

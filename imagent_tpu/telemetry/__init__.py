@@ -25,14 +25,17 @@ from imagent_tpu.telemetry.aggregate import (
 from imagent_tpu.telemetry.events import (
     SCHEMA_VERSION, TelemetryWriter, read_events,
 )
-from imagent_tpu.telemetry.goodput import PHASES, GoodputAccountant
+from imagent_tpu.telemetry.goodput import (
+    OVERLAP_PHASES, PHASES, GoodputAccountant,
+)
 from imagent_tpu.telemetry.profiler import (
     ProfilerSession, hbm_stats, parse_profile_at_step,
 )
 from imagent_tpu.telemetry.sampler import StepTimeSampler
 
 __all__ = [
-    "PHASES", "HOST_FIELDS", "SCHEMA_VERSION", "GoodputAccountant",
+    "PHASES", "OVERLAP_PHASES", "HOST_FIELDS", "SCHEMA_VERSION",
+    "GoodputAccountant",
     "StepTimeSampler", "TelemetryWriter", "TelemetrySession",
     "ProfilerSession", "allgather_host_stats", "flag_stragglers",
     "summarize_hosts", "hbm_stats", "parse_profile_at_step",
@@ -108,6 +111,13 @@ class TelemetrySession:
         if self.enabled and self._in_epoch:
             self.acct.add(name, seconds)
 
+    def overlap(self, name: str, seconds: float) -> None:
+        """Attribute background work that overlapped the epoch (async
+        checkpoint commits) — reported under ``overlap``, outside the
+        sum-to-wall phase partition."""
+        if self.enabled and self._in_epoch:
+            self.acct.add_overlapped(name, seconds)
+
     def count(self, name: str, inc: float = 1) -> None:
         if self.enabled and self._in_epoch:
             self.counters[name] = self.counters.get(name, 0) + inc
@@ -148,6 +158,7 @@ class TelemetrySession:
             self.counters["bad_steps"] = \
                 self.counters.get("bad_steps", 0) \
                 + int(train_m["bad_steps"])
+        overlap = self.acct.overlapped()
         wall, phases, goodput = self.acct.finish()
         pcts = self.sampler.percentiles()
         local = {
@@ -167,6 +178,7 @@ class TelemetrySession:
             "wall_s": round(wall, 3),
             "goodput": round(goodput, 4),
             "phases": {k: round(v, 3) for k, v in phases.items()},
+            "overlap": {k: round(v, 4) for k, v in overlap.items()},
             "step_ms": {k: round(v, 3) if isinstance(v, float) else v
                         for k, v in pcts.items()},
             "hosts": {"count": int(matrix.shape[0]),

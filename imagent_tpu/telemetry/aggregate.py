@@ -53,10 +53,12 @@ def allgather_host_stats(local: dict) -> np.ndarray:
     Ordering note: ``process_allgather`` executes as a device program,
     so on a pod it must not race other host-issued collectives from
     OTHER threads. The engine calls it only after the epoch's step
-    frontier is drained (``_finalize``); the one known offender is
-    orbax's async-save background barrier on the CPU/gloo test
-    backend, where gloo aborts on cross-thread reorder — TPU streams
-    serialize the same overlap harmlessly.
+    frontier is drained (``_LaggedMetrics.drain``); the one known
+    offender is orbax's async-save background barrier on the CPU/gloo
+    test backend, where gloo aborts on cross-thread reorder — TPU
+    streams serialize the same overlap harmlessly (the snapshot
+    committer thread of ``checkpoint.save_async`` is collective-free
+    by design, so the default async path has no such hazard).
     """
     vec = pack_host_vector(local)
     import jax

@@ -16,17 +16,28 @@ Phase taxonomy (``PHASES``):
   in between on a steady pipeline.
 * ``dispatch``   — non-compiling step dispatches (host side of useful
   training work; the device computes under them).
-* ``step_drain`` — the epoch-end metric sync (``engine._finalize``):
-  the host waiting for the device to retire the dispatched frontier —
-  the device-side tail of useful training work.
+* ``step_drain`` — the epoch-end tail wait (``engine._LaggedMetrics
+  .drain``): the host waiting for the device to retire the last
+  ``_GUARD_LAG`` dispatched steps — the device-side tail of useful
+  training work (the rest of the epoch's vectors were consumed lagged,
+  behind the dispatch, at zero wait).
 * ``input_wait`` — step loop blocked on the staging queue
   (``data/prefetch.py::PrefetchStats.wait_s``).
 * ``eval``       — validation epochs.
-* ``checkpoint`` — blocking portion of checkpoint saves (staging; the
-  async finalize overlaps training and is deliberately not charged).
+* ``checkpoint`` — blocking portion of checkpoint saves (the host
+  snapshot; the async commit overlaps training and is deliberately not
+  charged here).
 * ``recovery``   — resilience events: rollback restores, fallback
   walks.
 * ``host_other`` — the residual (never negative).
+
+Overlapped phases (``OVERLAP_PHASES``) account for work that runs
+CONCURRENTLY with the wall partition above — today the async
+checkpoint committer thread (``ckpt_commit_async``). They are tracked
+separately and are NOT part of the wall sum: adding hidden-behind-
+compute seconds into a partition that must sum to wall would double
+count the very overlap the async path buys. The epoch record carries
+them under ``overlap``.
 
 ``goodput`` = (compile-free step work) / wall =
 ``(dispatch + step_drain) / wall`` — the fraction of the epoch that
@@ -43,6 +54,10 @@ import time
 
 PHASES = ("compile", "dispatch", "step_drain", "input_wait", "eval",
           "checkpoint", "recovery", "host_other")
+
+# Work that overlaps the wall partition (background threads) — reported
+# alongside the phases but excluded from the sum-to-wall invariant.
+OVERLAP_PHASES = ("ckpt_commit_async",)
 
 # A step dispatch is asynchronous (microseconds); one that blocks this
 # long was compiling/retracing.  Conservative: a genuinely slow host
@@ -61,10 +76,12 @@ class GoodputAccountant:
     def __init__(self, compile_threshold_s: float = COMPILE_THRESHOLD_S):
         self.compile_threshold_s = float(compile_threshold_s)
         self._acc: dict[str, float] = {}
+        self._overlap: dict[str, float] = {p: 0.0 for p in OVERLAP_PHASES}
         self._t0: float | None = None
 
     def begin_epoch(self, now: float | None = None) -> None:
         self._acc = {p: 0.0 for p in PHASES}
+        self._overlap = {p: 0.0 for p in OVERLAP_PHASES}
         self._t0 = time.perf_counter() if now is None else now
 
     def add(self, phase: str, seconds: float) -> None:
@@ -72,6 +89,17 @@ class GoodputAccountant:
             raise ValueError(f"unknown phase {phase!r} (taxonomy: "
                              f"{', '.join(PHASES)})")
         self._acc[phase] += float(seconds)
+
+    def add_overlapped(self, phase: str, seconds: float) -> None:
+        """Attribute background-thread work that ran concurrently with
+        the wall partition (not summed into it — see module docstring)."""
+        if phase not in self._overlap:
+            raise ValueError(f"unknown overlapped phase {phase!r} "
+                             f"(taxonomy: {', '.join(OVERLAP_PHASES)})")
+        self._overlap[phase] += float(seconds)
+
+    def overlapped(self) -> dict[str, float]:
+        return dict(self._overlap)
 
     def add_dispatch(self, seconds: float) -> str:
         """Attribute one step dispatch; returns the phase it landed in."""

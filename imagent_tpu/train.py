@@ -745,6 +745,42 @@ def make_eval_step(model, mesh: Mesh,
     return jax.jit(sharded)
 
 
+def snapshotable(state: TrainState) -> bool:
+    """Whether every leaf's full value is reachable from THIS host
+    without a collective — the precondition for the async checkpoint
+    snapshot (``checkpoint.save_async``). True for single-host states
+    and multi-host *replicated* states (every device holds the whole
+    value, so one addressable shard is the array); False once a leaf is
+    genuinely sharded across hosts (multi-host FSDP/TP), where only a
+    collective gather could reassemble it."""
+    for x in jax.tree_util.tree_leaves(state):
+        if not isinstance(x, jax.Array):
+            continue
+        if x.is_fully_addressable:
+            continue
+        sharding = getattr(x, "sharding", None)
+        if sharding is None or not sharding.is_fully_replicated:
+            return False
+    return True
+
+
+def host_snapshot(state: TrainState) -> TrainState:
+    """Copy the state to host numpy — the blocking slice of an async
+    checkpoint. Runs on the MAIN thread before the next train step can
+    donate these buffers; everything after (serialization, commit,
+    manifest hashing) works on this copy from a background thread with
+    zero device or collective traffic. Requires ``snapshotable``."""
+    def fetch(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            # Fully replicated across hosts: any one local shard IS the
+            # whole array (np.asarray of the global view would demand
+            # full addressability).
+            return np.asarray(x.addressable_data(0))
+        return np.asarray(x)
+
+    return jax.tree.map(fetch, state)
+
+
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
     """Place the state replicated over the mesh — the DDP initial
     parameter broadcast (``imagenet.py:316``) done by sharding layout.

@@ -88,6 +88,10 @@ class TrainLogger:
             # `name` ranges over telemetry/goodput.py::PHASES — a fixed
             # 8-member taxonomy, so the series family is bounded.
             w.add_scalar(f"goodput/{name}_s", secs, epoch)  # jaxlint: disable=telemetry-tag-format -- tag family bounded by the fixed PHASES taxonomy, not per-step values
+        for name, secs in record.get("overlap", {}).items():
+            # `name` ranges over goodput.py::OVERLAP_PHASES — a fixed
+            # taxonomy like PHASES, so the family is bounded.
+            w.add_scalar(f"goodput/overlap_{name}_s", secs, epoch)  # jaxlint: disable=telemetry-tag-format -- tag family bounded by the fixed OVERLAP_PHASES taxonomy, not per-step values
         sm = record["step_ms"]
         w.add_scalar("steptime/p50_ms", sm["p50_ms"], epoch)
         w.add_scalar("steptime/p95_ms", sm["p95_ms"], epoch)

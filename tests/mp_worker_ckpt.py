@@ -1,0 +1,127 @@
+"""Async-checkpoint pod drill worker (2 OS processes), two phases via
+``IMAGENT_CKPT_PHASE``:
+
+``train``: both ranks form a real 2-process mesh, warm up (compile) a
+train step, then rank 0's committer thread runs a 2.5s-slowed async
+commit (``ckpt.slow_commit``) while BOTH ranks keep dispatching real
+train steps — cross-process gradient psums racing the commit thread,
+which is exactly the overlap the collective-free snapshot commit makes
+safe (a background Orbax barrier would abort gloo here). Each rank
+prints its dispatch wall-times; rank 0 prints the commit window; the
+parent asserts every rank dispatched inside it. Then a SECOND async
+commit is started with a long injected sleep and both ranks hard-exit
+mid-commit — the kill leaves a complete-looking live ``last`` with a
+dangling in-progress marker.
+
+``resume``: a fresh 2-process group restores: the marker must divert
+BOTH ranks past the half-committed ``last`` to the previous durable
+generation ``last.1`` (epoch 0) — pod-agreed, no torn candidate, no
+split-brain — via both the raw ``restore_resilient`` walk and the
+engine's ``--resume``-equivalent restore path.
+
+Usage: python mp_worker_ckpt.py <rank> <port> <world>  (scratch dir via
+IMAGENT_MP_SCRATCH).
+"""
+
+import os
+import sys
+import time
+
+
+def main() -> int:
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    scratch = os.environ["IMAGENT_MP_SCRATCH"]
+    phase = os.environ.get("IMAGENT_CKPT_PHASE", "train")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2")
+    os.environ.update({
+        "SLURM_JOB_NUM_NODES": "2",
+        "SLURM_NODEID": str(rank),
+        "SLURM_LOCALID": "0",
+        "SLURM_PROCID": str(rank),
+        "SLURM_NTASKS": "2",
+        "SLURM_JOB_NODELIST": "127.0.0.1",
+    })
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu import cluster
+    from imagent_tpu.models.vit import VisionTransformer
+    from imagent_tpu.resilience import faultinject
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step,
+        replicate_state, shard_batch,
+    )
+
+    senv = cluster.initialize("cpu", port=port)
+    assert senv is not None and senv.world_size == 2
+    mesh = cluster.make_mesh()
+
+    model = VisionTransformer(patch_size=8, hidden_dim=32, num_layers=1,
+                              num_heads=2, mlp_dim=32, num_classes=4)
+    opt = make_optimizer()
+    state = replicate_state(
+        create_train_state(model, jax.random.key(0), 16, opt), mesh)
+    step = make_train_step(model, opt, mesh)
+    ckpt_dir = os.path.join(scratch, "ck")  # shared-dir topology
+
+    rng = np.random.default_rng(rank)
+    images = rng.normal(size=(4, 16, 16, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(4,)).astype(np.int32)
+    lr = np.float32(0.05)
+
+    if phase == "train":
+        # Compile OUTSIDE the commit window so the in-window dispatch
+        # timestamps measure steady-state async dispatch, not tracing.
+        gi, gl = shard_batch(mesh, images, labels)
+        state, metrics = step(state, gi, gl, lr)
+        np.asarray(metrics)  # drain the warmup
+
+        faultinject.configure("ckpt.slow_commit:secs=2.5")
+        ckpt_lib.save_async(ckpt_dir, ckpt_lib.LAST, state,
+                            {"epoch": 0}, keep_last_k=1)
+        dispatched = []
+        for _ in range(6):
+            gi, gl = shard_batch(mesh, images, labels)
+            state, metrics = step(state, gi, gl, lr)
+            dispatched.append(time.time())
+        np.asarray(metrics)  # retire the frontier before the verdict
+        landed = ckpt_lib.poll_async(block=True)  # pod-agreed landing
+        assert landed is not None and landed["ok"], landed
+        if rank == 0:
+            win = ckpt_lib.commit_stats()
+            assert win is not None and win["ok"] is True
+            print(f"WINDOW {win['start']:.6f} {win['end']:.6f}",
+                  flush=True)
+        print("DISPATCHED "
+              + " ".join(f"{t:.6f}" for t in dispatched), flush=True)
+
+        # Mid-commit kill: generation 1's commit swaps in, then sleeps
+        # long past our exit — both ranks die with the marker dangling.
+        faultinject.configure("ckpt.slow_commit:secs=60")
+        ckpt_lib.save_async(ckpt_dir, ckpt_lib.LAST, state,
+                            {"epoch": 1}, keep_last_k=1)
+        time.sleep(2.0)  # rank 0's committer is inside the sleep now
+        print("KILLED_MID_COMMIT", flush=True)
+        sys.stdout.flush()
+        os._exit(0)
+
+    # phase == "resume": the requeued pod. The dangling marker must
+    # divert BOTH ranks past the half-committed `last` (epoch 1) to
+    # the durable `last.1` (epoch 0) together.
+    restored = ckpt_lib.restore_resilient(ckpt_dir, state)
+    assert restored is not None, "fallback chain came up empty"
+    _, meta, cand = restored
+    print(f"RESTORED {cand} {int(meta['epoch'])}", flush=True)
+
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,21 +39,28 @@ def main() -> int:
     from imagent_tpu.engine import run
 
     # 2 procs x 2 fake devices -> global batch 16, 64 imgs -> 4
-    # steps/epoch; 2 epochs with an eval epoch. save_model stays OFF:
-    # orbax's ASYNC save finalizes on a background thread whose
-    # internal barrier is a gloo psum on this backend, and gloo aborts
-    # when two threads interleave collectives differently across ranks
-    # (on TPU the runtime serializes per-device program order, so the
-    # same overlap is benign). The checkpoint/recovery phases are
-    # exercised by the single-process drills (test_fault_drills.py).
+    # steps/epoch; 2 epochs with an eval epoch. save_model is ON since
+    # the async snapshot-commit path (checkpoint.save_async): its
+    # committer thread is collective-free by design, so the per-epoch
+    # LAST save can overlap the gloo train psums that orbax's
+    # background-barrier async save used to abort on. (The BEST save
+    # is a blocking orbax save — main thread idle while it finalizes,
+    # so no cross-thread collective interleave either.)
     cfg = Config(arch="resnet18", image_size=16, num_classes=4,
                  batch_size=4, epochs=2, lr=0.05, dataset="synthetic",
                  synthetic_size=64, workers=0, bf16=False, log_every=0,
-                 seed=0, save_model=False, backend="cpu", eval_every=2,
+                 seed=0, save_model=True, keep_last_k=1, backend="cpu",
+                 eval_every=2,
                  log_dir=os.path.join(scratch, "tb"),
                  ckpt_dir=os.path.join(scratch, "ck"))
     result = run(cfg)
     assert result["rollbacks"] == 0 and not result["preempted"], result
+    # The async LAST commits landed durably (process 0 writes).
+    if rank == 0:
+        assert os.path.isfile(os.path.join(
+            scratch, "ck", "last", "snapshot.json"))
+        assert not os.path.exists(os.path.join(
+            scratch, "ck", "last.pending.json"))
     print(f"RUN_OK rank={rank} best_epoch={result['best_epoch']}",
           flush=True)
 

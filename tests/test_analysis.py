@@ -38,11 +38,12 @@ def rules_fired(findings):
     return {f.rule for f in findings}
 
 
-def test_registry_has_all_eight_rules():
+def test_registry_has_all_nine_rules():
     assert set(RULES) == {
         "host-sync-in-jit", "prng-key-reuse", "recompile-hazard",
         "nondeterministic-pytree-order", "missing-donation",
-        "dtype-contract", "untimed-block", "telemetry-tag-format"}
+        "dtype-contract", "untimed-block", "telemetry-tag-format",
+        "blocking-call-in-step-loop"}
     for r in RULES.values():
         assert r.doc  # every rule documents why it bites
 
@@ -561,6 +562,90 @@ def test_telemetry_tag_case_fires(tmp_path):
 def test_telemetry_tag_good_silent(tmp_path):
     assert lint_src(tmp_path, TAG_GOOD,
                     rule="telemetry-tag-format") == []
+
+
+# -------------------------------------------------------------- rule 9
+
+STEP_LOOP_BAD = """
+import numpy as np
+from imagent_tpu.data.prefetch import device_prefetch
+
+def train_epoch(mesh, step, state, batches, log):
+    for images, labels in device_prefetch(mesh, batches):
+        state, metrics = step(state, images, labels)
+        log(np.asarray(metrics))
+        log(metrics.item())
+    return state
+"""
+
+STEP_LOOP_VARIABLE_BAD = """
+import jax
+from imagent_tpu.data.prefetch import Prefetcher
+
+def train_epoch(mesh, step, state, batches):
+    it = Prefetcher(mesh, batches)
+    out = []
+    for arrays in it:
+        state, metrics = step(state, *arrays)
+        out.append(jax.block_until_ready(metrics))
+    return state, out
+"""
+
+STEP_LOOP_LAGGED_GOOD = """
+import numpy as np
+from imagent_tpu.data.prefetch import device_prefetch
+
+_GUARD_LAG = 2
+
+def train_epoch(mesh, step, state, batches, log):
+    buf = []
+    for images, labels in device_prefetch(mesh, batches):
+        state, metrics = step(state, images, labels)
+        buf.append(metrics)
+        if len(buf) > _GUARD_LAG:
+            log(np.asarray(buf[len(buf) - 1 - _GUARD_LAG]))
+    # The boundary drain happens OUTSIDE the loop.
+    total = np.asarray(buf[-1])
+    return state, total
+"""
+
+STEP_LOOP_PLAIN_GOOD = """
+import numpy as np
+
+def host_epoch(batches, log):
+    # A plain host loop (no prefetched source) may fetch freely.
+    for batch in batches:
+        log(np.asarray(batch))
+    it = iter(batches)
+    for x in it:
+        log(np.asarray(x))
+"""
+
+
+def test_step_loop_blocking_fetch_fires(tmp_path):
+    findings = lint_src(tmp_path, STEP_LOOP_BAD,
+                        rule="blocking-call-in-step-loop")
+    assert len(findings) == 2  # np.asarray + .item()
+    assert all("step loop" in f.message for f in findings)
+
+
+def test_step_loop_tracks_prefetcher_variable(tmp_path):
+    """The engine's idiom: the loop iterates a NAME assigned from a
+    Prefetcher(...) constructor, not the call itself."""
+    findings = lint_src(tmp_path, STEP_LOOP_VARIABLE_BAD,
+                        rule="blocking-call-in-step-loop")
+    assert len(findings) == 1
+    assert "block_until_ready" in findings[0].message
+
+
+def test_step_loop_lagged_read_and_plain_loops_silent(tmp_path):
+    # A statement referencing _GUARD_LAG reads the lagged frontier —
+    # the step already retired, the fetch is free.
+    assert lint_src(tmp_path, STEP_LOOP_LAGGED_GOOD,
+                    rule="blocking-call-in-step-loop") == []
+    # Loops over non-prefetched sources are out of scope.
+    assert lint_src(tmp_path, STEP_LOOP_PLAIN_GOOD,
+                    rule="blocking-call-in-step-loop") == []
 
 
 # ------------------------------------------------- suppressions/baseline

@@ -1,0 +1,233 @@
+"""Async snapshot-then-commit checkpointing (checkpoint.save_async):
+the snapshot-format round trip, the in-progress marker's
+half-committed-candidate skip, the watchdog commit monitor, and the
+acceptance drill — a TRUE 2-process CPU run where both ranks dispatch
+train steps INSIDE an injected-slow commit window, then die mid-commit
+and must restore a pod-agreed consistent generation (no torn candidate,
+no split-brain)."""
+
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+import pytest
+
+from mp_launch import launch_pair
+
+from imagent_tpu import checkpoint as ckpt_lib
+from imagent_tpu.cluster import make_mesh
+from imagent_tpu.models import create_model
+from imagent_tpu.resilience import faultinject
+from imagent_tpu.resilience.watchdog import StepWatchdog
+from imagent_tpu.train import (
+    create_train_state, host_snapshot, make_optimizer, replicate_state,
+    snapshotable,
+)
+
+
+def _tiny_state(arch="resnet18"):
+    return replicate_state(
+        create_train_state(create_model(arch, num_classes=4),
+                           jax.random.key(0), 16, make_optimizer()),
+        make_mesh(model_parallel=1))
+
+
+@pytest.fixture(scope="module")
+def state():
+    """One shared state: save_async snapshots it (read-only), so the
+    module's tests can share the expensive init."""
+    return _tiny_state()
+
+
+def test_snapshot_helpers_and_roundtrip(tmp_path, state):
+    """save_async serializes the host snapshot in the flat format;
+    restore returns bit-identical leaves and the in-format meta."""
+    assert snapshotable(state)
+    snap = host_snapshot(state)
+    assert all(isinstance(x, np.ndarray)
+               for x in jax.tree_util.tree_leaves(snap))
+
+    d = str(tmp_path)
+    assert ckpt_lib.save_async(d, "last", state, {"epoch": 3,
+                                                  "best_top1": 7.5}) \
+        is None  # nothing previously in flight
+    landed = ckpt_lib.poll_async(block=True)
+    assert landed is not None and landed["ok"] and landed["secs"] > 0
+    assert os.path.isfile(tmp_path / "last" / "snapshot.json")
+    assert not os.path.exists(tmp_path / "last.pending.json")
+
+    restored = ckpt_lib.restore(d, "last", state)
+    assert restored is not None
+    got, meta = restored
+    assert meta["epoch"] == 3 and meta["best_top1"] == 7.5
+    np.testing.assert_array_equal(
+        np.asarray(got.params["conv1"]["kernel"]),
+        np.asarray(jax.device_get(state.params["conv1"]["kernel"])))
+    # The integrity manifest covers the snapshot files too.
+    ok, detail = __import__(
+        "imagent_tpu.resilience.integrity",
+        fromlist=["verify"]).verify(d, "last")
+    assert ok, detail
+
+
+def test_snapshot_restore_rejects_wrong_arch(tmp_path, state):
+    """A snapshot checkpoint must fail loudly into the fallback walk on
+    a tree mismatch, exactly like the Orbax path."""
+    ckpt_lib.save_async(str(tmp_path), "last", state, {"epoch": 0})
+    ckpt_lib.wait_until_finished()
+    other = _tiny_state("resnet34")
+    with pytest.raises(ValueError, match="arch|shape|match"):
+        ckpt_lib.restore(str(tmp_path), "last", other)
+
+
+def test_marker_skips_half_committed_candidate(tmp_path, state):
+    """A dangling in-progress marker whose generation matches the live
+    meta means a kill interrupted the commit AFTER the swap: the walk
+    must skip the live candidate WITHOUT probing it and restore the
+    previous durable generation."""
+    d = str(tmp_path)
+    ckpt_lib.save_async(d, "last", state, {"epoch": 0}, keep_last_k=1)
+    ckpt_lib.save_async(d, "last", state, {"epoch": 1}, keep_last_k=1)
+    ckpt_lib.wait_until_finished()
+    # Re-create the post-crash state: marker for the live generation.
+    ckpt_lib._write_pending_marker(d, "last", {"epoch": 1})
+    assert ckpt_lib.fallback_candidates(d, "last")[0] == "last.1"
+    restored = ckpt_lib.restore_resilient(d, state)
+    assert restored is not None
+    _, meta, cand = restored
+    assert cand == "last.1" and meta["epoch"] == 0
+    # A marker for a DIFFERENT generation (crash before the swap) must
+    # NOT condemn the live checkpoint — it still holds good data.
+    ckpt_lib._write_pending_marker(d, "last", {"epoch": 99})
+    assert ckpt_lib.fallback_candidates(d, "last")[0] == "last"
+    ckpt_lib._clear_pending_marker(d, "last")
+
+
+def test_commit_monitor_fires_watchdog_on_wedged_commit(tmp_path, state,
+                                                        capsys):
+    """A committer thread running past its deadline must trip the
+    watchdog via the registered monitor: stack dump + fired flag (the
+    engine's checkpoint-and-exit stop path)."""
+    faultinject.configure("ckpt.slow_commit:secs=3")
+    wd = StepWatchdog(0.3)
+    wd.add_monitor(ckpt_lib.commit_monitor(0.5))
+    try:
+        ckpt_lib.save_async(str(tmp_path), "last", state, {"epoch": 0})
+        deadline = time.time() + 6.0
+        while not wd.fired and time.time() < deadline:
+            time.sleep(0.05)
+        assert wd.fired
+    finally:
+        faultinject.reset()
+        ckpt_lib.wait_until_finished()
+        wd.stop()
+    err = capsys.readouterr().err
+    assert "commit thread" in err and "all-thread stack dump" in err
+
+
+def test_commit_monitor_silent_after_commit_completes(tmp_path, state):
+    """The monitor's wedge clock stops when the committer THREAD
+    finishes, not when the verdict lands at the next boundary — a fast
+    successful commit followed by an epoch longer than the deadline
+    must not read as wedged (it would checkpoint-and-exit a healthy
+    run). Deadline 0 makes the check exact: ANY still-armed clock
+    fires, so silence proves the clock stopped at thread completion."""
+    check = ckpt_lib.commit_monitor(0.0)
+    ckpt_lib.save_async(str(tmp_path), "last", state, {"epoch": 0})
+    t = ckpt_lib._commit_thread
+    assert t is not None
+    # Finish the commit WITHOUT landing the verdict (poll_async) — the
+    # window where the false positive lived.
+    t.join(timeout=30.0)
+    assert not t.is_alive()
+    assert check() is None
+    landed = ckpt_lib.poll_async(block=True)
+    assert landed is not None and landed["ok"]
+
+
+def test_wait_until_finished_returns_failed_final_verdict(tmp_path,
+                                                          state,
+                                                          capsys):
+    """A commit still in flight at wait_until_finished — the FINAL
+    epoch's LAST commit in a real run — must surface its verdict to the
+    caller: a failure there has no next-epoch retry, so dropping it
+    would report a clean run over a stale checkpoint."""
+    d = str(tmp_path)
+    ckpt_lib.save_async(d, "last", state, {"epoch": 0})
+    assert ckpt_lib.wait_until_finished()["ok"]  # baseline: ok verdict
+    faultinject.configure("ckpt.commit_fail")
+    try:
+        ckpt_lib.save_async(d, "last", state, {"epoch": 1})
+        landed = ckpt_lib.wait_until_finished()
+    finally:
+        faultinject.reset()
+    assert landed is not None and not landed["ok"]
+    assert "commit_fail" in landed["error"]
+    # The epoch-0 generation survived the failed epoch-1 commit.
+    meta = json.loads((tmp_path / "last_meta.json").read_text())
+    assert meta["epoch"] == 0
+
+
+# ------------------------------------- acceptance: 2-process CPU drill
+
+def test_two_process_commit_overlap_then_kill_and_resume(tmp_path):
+    """The acceptance drill. Phase 1 (``train``): with
+    ``ckpt.slow_commit`` injected, BOTH ranks must dispatch train steps
+    (real cross-process psums) inside rank 0's commit wall-clock window
+    — the committer thread is collective-free, so the overlap is safe
+    even on gloo — then both ranks are killed mid-commit of the next
+    generation. Phase 2 (``resume``): a fresh pod must agree on the
+    previous durable generation (``last.1``, epoch 0) on every rank —
+    the dangling marker diverts everyone past the half-committed
+    candidate without probing it."""
+    os.environ["IMAGENT_MP_SCRATCH"] = str(tmp_path)
+    os.environ["IMAGENT_CKPT_PHASE"] = "train"
+    try:
+        outs = launch_pair("mp_worker_ckpt.py")
+    finally:
+        os.environ.pop("IMAGENT_CKPT_PHASE", None)
+
+    window = None
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("WINDOW"):
+                _, start, end = line.split()
+                window = (float(start), float(end))
+    assert window is not None, outs
+    assert window[1] - window[0] >= 2.5  # the injected sleep is inside
+    for out in outs:
+        assert "KILLED_MID_COMMIT" in out, out
+        dispatch_lines = [ln for ln in out.splitlines()
+                          if ln.startswith("DISPATCHED")]
+        assert dispatch_lines, out
+        times = [float(x) for x in dispatch_lines[0].split()[1:]]
+        inside = [t for t in times if window[0] < t < window[1]]
+        # Steps dispatched DURING the commit window, on this host.
+        assert inside, (window, times)
+
+    # The kill left the half-committed generation 1 live with its
+    # marker dangling.
+    assert (tmp_path / "ck" / "last.pending.json").exists()
+    live_meta = json.loads(
+        (tmp_path / "ck" / "last_meta.json").read_text())
+    assert live_meta["epoch"] == 1
+
+    os.environ["IMAGENT_MP_SCRATCH"] = str(tmp_path)
+    os.environ["IMAGENT_CKPT_PHASE"] = "resume"
+    try:
+        outs2 = launch_pair("mp_worker_ckpt.py")
+    finally:
+        os.environ.pop("IMAGENT_CKPT_PHASE", None)
+        os.environ.pop("IMAGENT_MP_SCRATCH", None)
+    restored = []
+    for out in outs2:
+        lines = [ln for ln in out.splitlines()
+                 if ln.startswith("RESTORED")]
+        assert lines, out
+        restored.append(lines[0])
+    # Pod-agreed: identical candidate + generation on every rank, and
+    # never the torn (half-committed) one.
+    assert restored[0] == restored[1] == "RESTORED last.1 0", restored

@@ -45,6 +45,29 @@ def test_phase_accounting_sums_to_wall():
     assert gp == pytest.approx((0.003 + 2.0) / 10.0)
 
 
+def test_overlapped_phase_outside_wall_partition():
+    """ckpt_commit_async accounts for background-thread work — it must
+    NOT enter the sum-to-wall partition (it ran concurrently with it)
+    and must reset per epoch like the phases."""
+    from imagent_tpu.telemetry import OVERLAP_PHASES
+
+    acct = GoodputAccountant()
+    acct.begin_epoch(now=100.0)
+    acct.add_dispatch(0.001)
+    acct.add_overlapped("ckpt_commit_async", 7.5)
+    with pytest.raises(ValueError, match="unknown overlapped phase"):
+        acct.add_overlapped("checkpoint", 1.0)
+    overlap = acct.overlapped()
+    assert set(overlap) == set(OVERLAP_PHASES)
+    assert overlap["ckpt_commit_async"] == pytest.approx(7.5)
+    wall, phases, _ = acct.finish(now=101.0)
+    # The overlapped seconds exceed the wall — fine, they were hidden
+    # behind it; the wall partition still sums exactly.
+    assert sum(phases.values()) == pytest.approx(wall, rel=1e-9)
+    acct.begin_epoch(now=200.0)
+    assert acct.overlapped()["ckpt_commit_async"] == 0.0
+
+
 def test_phase_accounting_residual_clamped_and_unknown_phase():
     acct = GoodputAccountant()
     acct.begin_epoch(now=0.0)
@@ -199,9 +222,9 @@ def test_engine_rejects_bad_profile_flags(tmp_path):
 
 # --------------------------------------------------- session + JSONL
 
-EPOCH_RECORD_KEYS = {"epoch", "wall_s", "goodput", "phases", "step_ms",
-                     "hosts", "stragglers", "counters", "hbm",
-                     "interrupted"}
+EPOCH_RECORD_KEYS = {"epoch", "wall_s", "goodput", "phases", "overlap",
+                     "step_ms", "hosts", "stragglers", "counters",
+                     "hbm", "interrupted"}
 
 
 def _driven_session(tmp_path):
