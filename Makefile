@@ -4,7 +4,7 @@
 PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
 
-.PHONY: smoke test lint bench-smoke
+.PHONY: smoke test lint bench-smoke bench-anatomy
 
 # Static-analysis gate (docs/STATIC_ANALYSIS.md): jaxlint — the
 # JAX/TPU-aware rules in imagent_tpu/analysis — over the package, the
@@ -43,3 +43,11 @@ test:
 # before a real bench run.
 bench-smoke:
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/bench_smoke.py
+
+# ConvNeXt-T per-stage block anatomy on the REAL chip, including the
+# fused-kernel columns (mlp_fused / block_fused) whose block-vs-fused
+# ratio at s0/s1 is the --fused-mlp accept-or-reject verdict
+# (docs/ROOFLINE.md "Fused ConvNeXt MLP"). Run on TPU; CNX_BATCH and
+# CNX_STAGE narrow the sweep.
+bench-anatomy:
+	$(PY) benchmarks/convnext_anatomy.py

@@ -34,6 +34,64 @@ BASELINE_IMG_S_PER_CHIP = 152.8  # reference img/s/GPU (BASELINE.md)
 NORTH_STAR_IMG_S_PER_CHIP = 1200.0  # BASELINE.json resnet50@224 target
 
 
+def _median_ci(samples) -> tuple[float, float, float]:
+    """Nonparametric (sign-test / binomial order-statistic) confidence
+    interval for the MEDIAN: ``(lo, hi, coverage_pct)``. Chooses the
+    narrowest symmetric order-statistic interval with >= 95% coverage;
+    small n cannot reach 95% (n=5 full range covers 93.75%), in which
+    case the full range is reported with its ACTUAL coverage — the JSON
+    self-explains what the estimator delivers instead of overclaiming
+    (VERDICT r5 weak 1)."""
+    from math import comb
+
+    xs = sorted(float(s) for s in samples)
+    n = len(xs)
+    if n < 2:
+        return xs[0], xs[0], 0.0
+    cdf = [comb(n, i) / 2.0 ** n for i in range(n + 1)]
+    best = None
+    for r in range(n // 2, 0, -1):  # narrowest first: largest r
+        coverage = 1.0 - 2.0 * sum(cdf[:r])
+        if coverage >= 0.95:
+            best = (xs[r - 1], xs[n - r], 100.0 * coverage)
+            break
+    if best is None:  # full range, honest coverage
+        best = (xs[0], xs[-1], 100.0 * (1.0 - 2.0 * cdf[0]))
+    return best
+
+
+def _spread_pct(samples) -> float:
+    med = float(np.median(samples))
+    if med <= 0:  # differencing noise swallowed the signal entirely
+        return float("inf")
+    return 100.0 * (max(samples) - min(samples)) / med
+
+
+def _robust_samples(sample_fn, pairs: int, max_spread_pct: float,
+                    max_rounds: int) -> tuple[list, int, int]:
+    """Collect paired-window samples with outlier rejection + retry
+    (VERDICT r5 weak 1: the r18@448 config's tunnel-contention spread
+    exceeded the README's advertised band). Round 1 collects ``pairs``
+    samples; while their spread exceeds ``max_spread_pct``, samples
+    outside a half-band around the median are REJECTED and replaced
+    with fresh windows, up to ``max_rounds`` total rounds. A persistent
+    noise floor is reported, not hidden: the loop exits with whatever
+    spread remains and the caller publishes it plus the median CI.
+    Returns ``(samples, n_rejected, rounds)``."""
+    samples = [sample_fn() for _ in range(pairs)]
+    rejected = 0
+    rounds = 1
+    while _spread_pct(samples) > max_spread_pct and rounds < max_rounds:
+        med = float(np.median(samples))
+        band = med * max_spread_pct / 200.0  # half-band: total <= bound
+        keep = [s for s in samples if abs(s - med) <= band]
+        rejected += len(samples) - len(keep)
+        keep += [sample_fn() for _ in range(pairs - len(keep))]
+        samples = keep
+        rounds += 1
+    return samples, rejected, rounds
+
+
 def chip_calibration() -> dict:
     """Per-run chip-state snapshot (VERDICT r4 item 2): the roofline
     copy-bandwidth and matmul microbenches ride alongside every BENCH
@@ -50,6 +108,7 @@ def chip_calibration() -> dict:
 def measure(arch: str, size: int, per_chip_batch: int,
             optimizer: str = "sgd", bf16: bool = True,
             pairs: int = 5, lo_iters: int = 3, hi_iters: int = 15,
+            max_spread_pct: float = 8.0, max_rounds: int = 3,
             model_kw: dict | None = None) -> dict:
     """Shared measurement harness (also used by benchmarks/throughput.py):
     jitted train step, synthetic device-resident batches, analytic-FLOPs
@@ -121,12 +180,18 @@ def measure(arch: str, size: int, per_chip_batch: int,
         np.asarray(metrics)  # sync: last step depends on the whole chain
         return time.perf_counter() - t0
 
-    samples = []
-    for _ in range(pairs):
+    def sample():
         t_lo = window(lo_iters)
         t_hi = window(hi_iters)
-        samples.append((t_hi - t_lo) / (hi_iters - lo_iters))
+        return (t_hi - t_lo) / (hi_iters - lo_iters)
+
+    # Outlier rejection + retry on the high-variance (tunnel-contended)
+    # configs, and an order-statistic CI on the median so the JSON
+    # carries what the estimator actually resolves (VERDICT r5 weak 1).
+    samples, n_rejected, rounds = _robust_samples(
+        sample, pairs, max_spread_pct, max_rounds)
     per_step = float(np.median(samples))
+    ci_lo, ci_hi, ci_cov = _median_ci(samples)
 
     img_s_chip = batch / per_step / n_chips
     step_flops = train_step_flops_per_image(forward_flops(arch, size))
@@ -142,10 +207,23 @@ def measure(arch: str, size: int, per_chip_batch: int,
         "compute_dtype": "bf16" if bf16 else "fp32",
         "optimizer": optimizer,
         "method": (f"paired-window differencing, median of {pairs} "
-                   f"({lo_iters}/{hi_iters} chained iters)"),
-        "spread_pct": round(100.0 * (max(samples) - min(samples))
-                            / per_step, 2),
+                   f"({lo_iters}/{hi_iters} chained iters), "
+                   f"spread>{max_spread_pct:g}% rejected+retried "
+                   f"(max {max_rounds} rounds)"),
+        "spread_pct": round(_spread_pct(samples), 2),
+        "samples_rejected": n_rejected,
+        "sample_rounds": rounds,
     }
+    if ci_lo > 0 and ci_cov > 0:
+        # Median CI in img/s/chip (per-step maps inversely), published
+        # only together with its coverage. A non-positive low bound
+        # means the differencing noise swamped the signal — spread_pct
+        # already says so, no fake interval (and no orphan coverage
+        # claim); n<2's degenerate zero-coverage interval likewise
+        # stays out of the JSON.
+        out["ci_img_s"] = [round(batch / ci_hi / n_chips, 2),
+                           round(batch / ci_lo / n_chips, 2)]
+        out["ci_coverage_pct"] = round(ci_cov, 2)
     # MFU only against a peak that matches the compute dtype — there is
     # no per-chip fp32 peak table here, and fp32 achieved FLOPs over the
     # bf16 peak is not a meaningful utilization figure.

@@ -148,7 +148,21 @@ def vit_to_torch(params: dict) -> dict:
     and the [H, hd, D] out projection flattens back to [D, H*hd].
     Round-trip is bit-exact (tests/test_torch_compat.py). Completes the
     train-here/serve-in-torch story for the third family alongside
-    ``resnet_to_torch``/``convnext_to_torch``."""
+    ``resnet_to_torch``/``convnext_to_torch``.
+
+    Stacked/pipelined ViTs (``models/vit.py stacked=True`` / the
+    pipeline layout) carry their encoder weights as one leading-axis-
+    stacked ``encoder`` subtree with NO ``encoder_layer_i`` keys — the
+    per-layer loop below would silently write a state_dict containing
+    only stem/ln/head tensors (strict torch loads fail later; strict=
+    False callers silently keep random encoder weights). Refuse before
+    writing anything."""
+    if "encoder_layer_0" not in params:
+        raise ValueError(
+            "stacked/pipelined params not supported for torch export: "
+            "no 'encoder_layer_0' key (nn.scan layer-stacked layout) — "
+            "convert to the per-layer layout first, or train/export "
+            "with the unstacked model")
     d = np.asarray(params["class_token"]).shape[-1]
     sd: dict = {
         "conv_proj.weight": _conv_inv(params["conv_proj"]["kernel"]),

@@ -205,6 +205,15 @@ class Config:
     # Single-chip attention kernel (ViT only): full (XLA einsum) | flash
     # (Pallas fused kernel, ops/flash_attention.py).
     attn: str = "full"
+    # ConvNeXt block lowering (ops/fused_mlp.py): Pallas-fused
+    # LN -> C->4C -> GELU -> 4C->C -> layer-scale -> residual with the
+    # 4C intermediate VMEM-resident (never written to HBM) and a
+    # custom VJP that recomputes it in the backward. "auto" fuses only
+    # where the backward working set fits VMEM and the backend is TPU;
+    # "on" forces the kernel (interpret off-TPU; VMEM overflow still
+    # falls back); "off" (default, opt-in pending the hardware verdict
+    # in docs/ROOFLINE.md) is bit-for-bit today's path.
+    fused_mlp: str = "off"
     # ViT perf/regularization levers (models/vit.py): one-GEMM QKV
     # projection (same param tree) and DINOv2-style register tokens
     # (appended, excluded from readout; 59 fills 224px ViT-B/16's 197
@@ -392,6 +401,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attn", type=str, default=c.attn,
                    choices=["full", "flash"],
                    help="ViT attention kernel (flash = Pallas fused)")
+    p.add_argument("--fused-mlp", type=str, default=c.fused_mlp,
+                   choices=["auto", "on", "off"],
+                   help="ConvNeXt: Pallas-fused LN->MLP->residual block "
+                        "lowering, 4C intermediate kept in VMEM (auto = "
+                        "fuse where the tile fits VMEM on TPU; off = "
+                        "today's path)")
     p.add_argument("--fused-qkv", action="store_true",
                    default=c.fused_qkv,
                    help="ViT: one fused QKV GEMM (same param tree)")

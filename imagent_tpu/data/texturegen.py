@@ -200,6 +200,12 @@ def generate_imagefolder(root: str, n_classes: int = 8,
         # manifests stay valid, existing datasets aren't regenerated
     if label_noise:
         manifest["label_noise"] = label_noise
+        # Render-index scheme version for noisy images (v2: fresh
+        # per-slot indices — see below). Mismatching manifests force a
+        # regenerate, so datasets produced by the v1 duplicate-prone
+        # scheme are rebuilt; clean (label_noise=0) datasets keep their
+        # manifests and are untouched.
+        manifest["noise_scheme"] = 2
     mpath = os.path.join(root, "manifest.json")
     if os.path.exists(mpath):
         try:
@@ -220,7 +226,7 @@ def generate_imagefolder(root: str, n_classes: int = 8,
             d = os.path.join(root, split, f"class_{cls}")
             os.makedirs(d, exist_ok=True)
             for i in range(per_class):
-                content_cls = cls
+                content_cls, render_idx = cls, base + i
                 if label_noise and split == "train":
                     # Deterministic train-only label noise: content from
                     # a uniformly random OTHER class, filed under `cls`.
@@ -230,8 +236,19 @@ def generate_imagefolder(root: str, n_classes: int = 8,
                         content_cls = int(nrng.integers(0, n_classes - 1))
                         if content_cls >= cls:
                             content_cls += 1
+                        # Fresh render index per (labelled class, slot):
+                        # rendering the donor at index base+i would be
+                        # byte-identical to the donor class's own image
+                        # at that slot — an exact duplicate with a
+                        # conflicting label, not a new draw (ADVICE r5
+                        # #3). The offset range is disjoint from both
+                        # splits' index ranges, so noisy images are
+                        # fresh deterministic samples of the donor
+                        # class.
+                        render_idx = (20_000_000
+                                      + cls * train_per_class + i)
                 Image.fromarray(
-                    gen(content_cls, base + i, n_classes, img,
+                    gen(content_cls, render_idx, n_classes, img,
                         hue_jitter)).save(
                         os.path.join(d, f"{i:05d}.jpg"), quality=quality)
     with open(mpath, "w") as f:

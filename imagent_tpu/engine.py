@@ -470,7 +470,8 @@ def _load_torch_weights(cfg: Config, state: TrainState) -> TrainState:
     return state.replace(params=params, batch_stats=stats)
 
 
-def _export_torch(cfg: Config, state, is_master: bool) -> None:
+def _export_torch(cfg: Config, state, is_master: bool,
+                  prefer_best: bool = False) -> None:
     """--export-torch: write the final params (+ batch_stats) as a
     torchvision-named torch ``state_dict`` — the inverse of
     ``--init-from-torch`` (the reference's checkpoint format,
@@ -478,9 +479,39 @@ def _export_torch(cfg: Config, state, is_master: bool) -> None:
     directly). Under ``--ema-decay`` the EMA weights are exported —
     the same weights every reported val metric was evaluated on
     (``evaluate()``), so the exported model reproduces the logged
-    accuracy. Runs after training or the ``--eval-only`` pass."""
+    accuracy. Runs after training or the ``--eval-only`` pass.
+
+    ``prefer_best`` (the end-of-training call site): the run summary
+    headlines ``best_top1``, and the reference's ``.pt`` is saved at
+    the best epoch (``imagenet.py:388-392``) — so when ``--save-model``
+    kept a BEST checkpoint, export THOSE weights, not the final-epoch
+    state. Falls back to the final state with a logged warning when no
+    BEST is restorable (--save-model off, or no eval improved), in
+    which case the export matches ``final_val``, not ``best_top1``.
+    The restore goes through ``restore_resilient`` so every verdict is
+    pod-agreed: one host with a missing/torn BEST replica must divert
+    ALL hosts to the same fallback (or to the final state), never
+    allgather an export whose shards mix two generations."""
     if not cfg.export_torch:
         return
+    if prefer_best:
+        restored = (ckpt_lib.restore_resilient(cfg.ckpt_dir, state,
+                                               name=ckpt_lib.BEST)
+                    if cfg.save_model else None)
+        if restored is not None:
+            state, best_meta, _cand = restored
+            if is_master:
+                print("exporting the BEST checkpoint (epoch "
+                      f"{int(best_meta.get('epoch', -1)) + 1}, top1 "
+                      f"{float(best_meta.get('best_top1', 0.0)):.3f}) — "
+                      "the weights behind the summary's best_top1",
+                      flush=True)
+        elif is_master:
+            print("WARNING: --export-torch exporting the FINAL-epoch "
+                  "state (no BEST checkpoint to restore"
+                  + ("" if cfg.save_model else "; --save-model is off")
+                  + ") — the export matches final_val, not best_top1",
+                  flush=True)
     # Eval parity: export what evaluate() scores.
     if cfg.ema_decay > 0.0 and state.ema_params is not None:
         state = state.replace(params=state.ema_params)
@@ -605,6 +636,14 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
     if cfg.attn != "full" and use_sp:
         raise ValueError("--attn and --seq-parallel are mutually exclusive: "
                          "the seq-parallel kernels replace attention")
+    if cfg.fused_mlp not in ("auto", "on", "off"):
+        raise ValueError("--fused-mlp must be one of auto|on|off, got "
+                         f"{cfg.fused_mlp!r}")
+    if cfg.fused_mlp == "on" and not cfg.arch.startswith("convnext"):
+        raise ValueError("--fused-mlp on requires a ConvNeXt arch (the "
+                         "fused block is the ConvNeXt inverted "
+                         f"bottleneck; got --arch={cfg.arch}). auto/off "
+                         "are no-ops elsewhere.")
     use_tp = cfg.tensor_parallel
     if use_tp and (not cfg.arch.startswith("vit") or cfg.model_parallel < 2):
         raise ValueError(
@@ -623,6 +662,16 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
         raise ValueError("ResNet pipeline parallelism is 2-stage "
                          "(--pipeline-parallel 2); deeper conv-stage "
                          "pipelines need a ViT arch")
+    if cfg.export_torch and use_pp and cfg.arch.startswith("vit"):
+        # Fail BEFORE pod time: the pipelined ViT's params are layer-
+        # stacked (nn.scan — no encoder_layer_i keys) and
+        # compat.vit_to_torch refuses them, so the export at the END of
+        # the run would crash after the whole training budget is spent.
+        raise ValueError(
+            "--export-torch does not support the pipelined ViT "
+            "(layer-stacked params have no encoder_layer_i keys for "
+            "the torchvision state_dict); export from a non-pipelined "
+            "run, or drop --export-torch")
     # pp x sp composes: stages shard layers over `pipe` while ring /
     # Ulysses attention shards tokens over `model` inside each stage
     # (exactness-tested in tests/test_pp_sp.py).
@@ -744,8 +793,31 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
         if cfg.arch.startswith("vit"):
             kw = vit_kw
         elif cfg.arch.startswith("convnext"):
-            kw = {}  # stem/vit levers don't apply; drop-path is
-            # library-level (models/convnext.py docstring)
+            # stem/vit levers don't apply; drop-path is library-level
+            # (models/convnext.py docstring). --fused-mlp selects the
+            # Pallas block lowering (same param tree in every mode).
+            kw = {"fused_mlp": cfg.fused_mlp}
+            if cfg.fused_mlp != "off" and is_master:
+                from imagent_tpu.models.convnext import CONVNEXT_DEFS
+                from imagent_tpu.ops.fused_mlp import fused_mlp_plan
+                # Unknown arch: stay silent and let create_model below
+                # raise its friendly unknown-arch ValueError.
+                if cfg.arch in CONVNEXT_DEFS:
+                    cd = jnp.bfloat16 if cfg.bf16 else jnp.float32
+                    dims = CONVNEXT_DEFS[cfg.arch][1]
+                    plan = fused_mlp_plan(cfg.fused_mlp, dims, dtype=cd)
+                    # "on"-mode plan = pure VMEM fit: attributes each
+                    # unfused entry to VMEM vs the non-TPU backend.
+                    fit = fused_mlp_plan("on", dims, dtype=cd)
+
+                    def why(d):
+                        return "VMEM" if fit[d] is None else "backend"
+
+                    print("fused-mlp " + cfg.fused_mlp + ": "
+                          + ", ".join(
+                              f"C={d} " + (f"fused (rows={br})" if br
+                                           else f"unfused ({why(d)})")
+                              for d, br in plan.items()), flush=True)
         else:
             kw = {"stem": cfg.stem}
         model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
@@ -1153,7 +1225,7 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
         # Skip under preemption: the grace window is for the mid-epoch
         # checkpoint, not a full-model serialize — the resumed run
         # exports the true final state.
-        _export_torch(cfg, state, is_master)
+        _export_torch(cfg, state, is_master, prefer_best=True)
     total_min = (time.time() - run_t0) / 60.0
     logger.final_summary(best_epoch, best_top1, best_top5, total_min)
     summary = {"best_top1": best_top1, "best_top5": best_top5,

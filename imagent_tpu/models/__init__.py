@@ -46,6 +46,7 @@ def create_model(arch: str, num_classes: int = 1000, bf16: bool = False,
         from imagent_tpu.models.convnext import CONVNEXT_REGISTRY
         remat = overrides.pop("remat", False)
         drop_path = overrides.pop("drop_path_rate", 0.0)
+        fused_mlp = overrides.pop("fused_mlp", "off")
         if overrides:
             raise ValueError(f"overrides {sorted(overrides)} do not apply "
                              "to the ConvNeXt family")
@@ -54,7 +55,8 @@ def create_model(arch: str, num_classes: int = 1000, bf16: bool = False,
                 f"unknown arch {arch!r}; one of {available_models()}")
         return CONVNEXT_REGISTRY[arch](num_classes=num_classes, dtype=dtype,
                                        remat=remat,
-                                       drop_path_rate=drop_path)
+                                       drop_path_rate=drop_path,
+                                       fused_mlp=fused_mlp)
     remat = overrides.pop("remat", False)  # shared flag, both families
     stem = overrides.pop("stem", "v1")
     if overrides:

@@ -35,6 +35,20 @@ scaled drop probability and per-sample ("row") masks, but defaults to
 ``rngs={"droppath": key}`` to ``apply`` — the production train step
 (train.make_train_step) applies without rngs and therefore supports
 rate 0.0 only. ``tests/test_models.py`` covers both modes.
+
+``fused_mlp`` ("auto"|"on"|"off", the --fused-mlp flag) selects the
+Pallas fused lowering of each block's LN -> C->4C -> GELU -> 4C->C ->
+layer-scale -> residual chain (``ops/fused_mlp.py``: the 4C
+intermediate stays in VMEM instead of round-tripping HBM; custom VJP
+recomputes it in the backward). The parameter tree is IDENTICAL in all
+three modes — the fused path reads the same ``norm``/``pwconv1``/
+``pwconv2``/``layer_scale`` leaves the unfused modules own — so
+checkpoints, EMA, torch import/export, and sharding specs are
+unaffected. "auto" fuses only where the backward working set fits VMEM
+and the backend is TPU; "on" forces the kernel (interpret mode off-TPU
+— how CI exercises it) but still falls back on VMEM overflow; an
+active drop-path mask always falls back (the fused chain is the
+production rate-0.0 block). "off" is bit-for-bit today's path.
 """
 
 from __future__ import annotations
@@ -62,6 +76,7 @@ class ConvNeXtBlock(nn.Module):
     dim: int
     drop_prob: float = 0.0
     dtype: jnp.dtype = jnp.float32
+    fused_mlp: str = "off"  # auto|on|off (ops/fused_mlp.py lowering)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -69,16 +84,34 @@ class ConvNeXtBlock(nn.Module):
                     feature_group_count=self.dim, use_bias=True,
                     dtype=self.dtype, kernel_init=trunc_init,
                     name="dwconv")(x)
+        gamma = self.param("layer_scale",
+                           nn.initializers.constant(1e-6), (self.dim,))
+        from imagent_tpu.ops.fused_mlp import (
+            fused_block_rows, fused_mlp_block,
+        )
+        dropping = self.drop_prob > 0.0 and train
+        block_rows = fused_block_rows(self.fused_mlp, self.dim,
+                                      dtype=self.dtype, dropping=dropping)
+        if block_rows is not None and not self.is_initializing():
+            # Fused lowering: LN -> MLP -> layer-scale -> residual in
+            # one Pallas pass, reading the SAME param leaves the
+            # unfused modules below own (created at init, which always
+            # runs the unfused path) — the tree never changes.
+            p_norm = self.get_variable("params", "norm")
+            p1 = self.get_variable("params", "pwconv1")
+            p2 = self.get_variable("params", "pwconv2")
+            return fused_mlp_block(
+                x, y, p_norm["scale"], p_norm["bias"],
+                p1["kernel"], p1["bias"], p2["kernel"], p2["bias"],
+                gamma, eps=1e-6, block_rows=block_rows)
         y = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="norm")(y)
         y = nn.Dense(4 * self.dim, dtype=self.dtype,
                      kernel_init=trunc_init, name="pwconv1")(y)
         y = nn.gelu(y, approximate=False)
         y = nn.Dense(self.dim, dtype=self.dtype,
                      kernel_init=trunc_init, name="pwconv2")(y)
-        gamma = self.param("layer_scale",
-                           nn.initializers.constant(1e-6), (self.dim,))
         y = y * gamma.astype(self.dtype)
-        if self.drop_prob > 0.0 and train:
+        if dropping:
             keep = 1.0 - self.drop_prob
             mask = jax.random.bernoulli(
                 self.make_rng("droppath"), keep,
@@ -96,6 +129,7 @@ class ConvNeXt(nn.Module):
     drop_path_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     remat: bool = False  # jax.checkpoint each block on backward
+    fused_mlp: str = "off"  # auto|on|off Pallas block lowering
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -120,6 +154,7 @@ class ConvNeXt(nn.Module):
                 # torchvision: sd_prob = rate * block_id / (total - 1)
                 p = (self.drop_path_rate * block_id / max(total - 1, 1))
                 x = block_cls(dim=dim, drop_prob=p, dtype=self.dtype,
+                              fused_mlp=self.fused_mlp,
                               name=f"stage{i}_block{j}")(x, train=train)
                 block_id += 1
         x = jnp.mean(x, axis=(1, 2))  # global average pool

@@ -371,3 +371,37 @@ def test_texture_hard_scheme(tmp_path):
         got = (pathlib.Path(root) / "val" / f"class_{cls}"
                / "00000.jpg").read_bytes()
         assert got == buf.getvalue()  # val clean
+
+
+def test_label_noise_images_are_fresh_draws(tmp_path):
+    """ADVICE r5 #3 regression: the v1 noise scheme rendered the donor
+    class at the SAME slot index, so every noisy train image was a
+    byte-exact duplicate of the donor class's own image — two identical
+    JPEGs with conflicting labels. v2 renders noise at a disjoint index
+    range: no two images in the whole dataset may share bytes, and the
+    manifest carries the scheme version so v1 datasets regenerate."""
+    import json
+    import pathlib
+
+    from imagent_tpu.data.texturegen import generate_imagefolder
+
+    root = str(tmp_path / "noisy")
+    generate_imagefolder(root, n_classes=6, train_per_class=12,
+                         val_per_class=2, img=32, scheme="huehard",
+                         label_noise=0.5)
+    paths = sorted(pathlib.Path(root).rglob("*.jpg"))
+    blobs = {}
+    for p in paths:
+        b = p.read_bytes()
+        assert b not in blobs, f"{p} duplicates {blobs[b]}"
+        blobs[b] = p
+
+    man = json.load(open(f"{root}/manifest.json"))
+    assert man["noise_scheme"] == 2
+
+    # A clean dataset's manifest is scheme-version-free (untouched by
+    # the v2 migration: no forced regeneration where no noise exists).
+    clean = str(tmp_path / "clean")
+    generate_imagefolder(clean, n_classes=4, train_per_class=2,
+                         val_per_class=1, img=32, scheme="huehard")
+    assert "noise_scheme" not in json.load(open(f"{clean}/manifest.json"))

@@ -47,6 +47,12 @@ def _cfg(**kw):
      "--pipeline-parallel covers"),
     (dict(arch="convnext_tiny", stem="s2d"),
      "--stem applies to the ResNet family"),
+    (dict(fused_mlp="banana"), "--fused-mlp must be one of"),
+    (dict(arch="vit_b16", pipeline_parallel=2, export_torch="out.pt"),
+     "--export-torch does not support the pipelined ViT"),
+    (dict(fused_mlp="on"), "--fused-mlp on requires a ConvNeXt"),
+    (dict(arch="vit_b16", fused_mlp="on"),
+     "--fused-mlp on requires a ConvNeXt"),
 ])
 def test_invalid_combinations_rejected(kw, match):
     with pytest.raises(ValueError, match=match):

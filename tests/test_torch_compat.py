@@ -583,3 +583,78 @@ def test_convnext_to_torch_roundtrip():
     for k in sd:
         np.testing.assert_array_equal(back[k], sd[k])
         assert back[k].shape == sd[k].shape
+
+
+def test_vit_to_torch_rejects_stacked_params():
+    """ADVICE r5 #1 regression: a stacked/pipelined ViT carries its
+    encoder as ONE leading-axis-stacked `encoder` subtree (nn.scan) —
+    no `encoder_layer_i` keys — and the old exporter silently wrote a
+    state_dict with only stem/ln/head tensors. It must refuse before
+    writing anything."""
+    from imagent_tpu.compat import vit_to_torch
+
+    m = VisionTransformer(patch_size=8, hidden_dim=32, num_layers=2,
+                          num_heads=4, mlp_dim=64, num_classes=8,
+                          stacked=True)
+    v = m.init(jax.random.key(0),
+               np.zeros((1, 16, 16, 3), np.float32), train=False)
+    assert "encoder_layer_0" not in v["params"]  # the stacked layout
+    with pytest.raises(ValueError,
+                       match="stacked/pipelined params not supported"):
+        vit_to_torch(v["params"])
+
+
+def test_export_torch_prefers_best_checkpoint(tmp_path, capsys):
+    """ADVICE r5 #2 regression: the run summary headlines best_top1
+    and the reference saves its .pt at the best epoch — so the
+    end-of-training --export-torch must ship the BEST checkpoint's
+    weights when --save-model kept one, and fall back to the final
+    state with a LOUD warning otherwise."""
+    import jax.numpy as jnp
+
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu.compat import to_torch_state_dict
+    from imagent_tpu.config import Config
+    from imagent_tpu.engine import _export_torch
+    from imagent_tpu.train import create_train_state, make_optimizer
+
+    model = create_model("resnet18", num_classes=4)
+    final = create_train_state(model, jax.random.key(0), 16,
+                               make_optimizer())
+    # A BEST checkpoint with distinguishable weights (the +1.0 shift).
+    best = final.replace(params=jax.tree.map(lambda p: p + 1.0,
+                                             final.params))
+    ckpt_lib.save(str(tmp_path / "ckpt"), ckpt_lib.BEST, best,
+                  {"epoch": 2, "best_top1": 77.0})
+
+    pt = tmp_path / "best.pt"
+    cfg = Config(arch="resnet18", num_classes=4, image_size=16,
+                 save_model=True, export_torch=str(pt),
+                 ckpt_dir=str(tmp_path / "ckpt"))
+    _export_torch(cfg, final, is_master=True, prefer_best=True)
+    assert "exporting the BEST checkpoint (epoch 3, top1 77.000)" in (
+        capsys.readouterr().out)
+    sd = torch.load(pt, weights_only=True)
+    want = to_torch_state_dict("resnet18", jax.device_get(best.params),
+                               jax.device_get(best.batch_stats))
+    assert set(sd) == set(want)
+    for k in want:
+        np.testing.assert_allclose(sd[k].numpy(),
+                                   np.asarray(want[k], np.float32),
+                                   rtol=1e-6, atol=1e-6, err_msg=k)
+
+    # No restorable BEST (--save-model off): final state + warning.
+    pt2 = tmp_path / "final.pt"
+    cfg2 = cfg.replace(save_model=False, export_torch=str(pt2),
+                       ckpt_dir=str(tmp_path / "none"))
+    _export_torch(cfg2, final, is_master=True, prefer_best=True)
+    out = capsys.readouterr().out
+    assert "WARNING: --export-torch exporting the FINAL-epoch" in out
+    assert "--save-model is off" in out
+    sd2 = torch.load(pt2, weights_only=True)
+    want2 = to_torch_state_dict("resnet18", jax.device_get(final.params),
+                                jax.device_get(final.batch_stats))
+    for k in want2:
+        np.testing.assert_allclose(sd2[k].numpy(),
+                                   np.asarray(want2[k], np.float32),
+                                   rtol=1e-6, atol=1e-6, err_msg=k)
