@@ -4,7 +4,7 @@
 PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
 
-.PHONY: smoke test lint bench-smoke bench-anatomy
+.PHONY: smoke test lint bench-smoke bench-anatomy drill-pod
 
 # Static-analysis gate (docs/STATIC_ANALYSIS.md): jaxlint — the
 # JAX/TPU-aware rules in imagent_tpu/analysis — over the package, the
@@ -33,6 +33,16 @@ smoke: lint
 # The full tier-1 gate (what CI runs).
 test:
 	$(PYTEST) -m "not slow" --continue-on-collection-errors tests/
+
+# Partial-pod failure drills (docs/OPERATIONS.md "Partial-pod failure
+# and requeue"): the 2-process deadman kill + requeue-resume drill,
+# the storage-outage drills, the tombstone-classification suite, and
+# the requeue-wrapper contract. All tier-1 (registered with the
+# existing marker scheme); this target is the focused loop for working
+# on the resilience layer.
+drill-pod:
+	$(PYTEST) -m "not slow" tests/test_pod_failure.py \
+	    tests/test_launch.py
 
 # Tiny synthetic-data bench iteration through the real input path
 # (uint8 wire -> device_prefetch -> in-graph normalize -> step) on the

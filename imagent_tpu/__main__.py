@@ -2,9 +2,14 @@
 
 The reference's ``__main__`` block (``imagenet.py:433-452``) — argparse →
 ``run(args)`` — with the same flag surface plus the promoted constants
-(see ``config.py``).
+(see ``config.py``), and the exit-code taxonomy the launcher's requeue
+wrapper keys on (``resilience/exitcodes.py``): a preempted or
+peer-death run exits retryable so ``launch/requeue.sh`` restarts the
+pod onto ``--resume``; config errors and reproducible faults exit
+non-retryable so a broken invocation does not crash-loop.
 """
 
+import os
 import sys
 
 from imagent_tpu.config import parse_args
@@ -16,8 +21,48 @@ def main(argv=None) -> int:
     # --backend=tpu means "runtime auto-selects the accelerator"; cpu/gpu
     # are forced explicitly there.
     from imagent_tpu.engine import run
-    run(cfg)
-    return 0
+    from imagent_tpu.resilience import exitcodes
+
+    def _announce(code: int) -> int:
+        entry = exitcodes.describe(code)
+        kind = ("retryable — the launcher requeues onto --resume"
+                if entry and entry.retryable else "not retryable")
+        name = entry.name if entry else "?"
+        print(f"exit {code} ({name}; {kind})", flush=True)
+        return code
+
+    try:
+        summary = run(cfg)
+    except exitcodes.FatalRunError as e:
+        print(f"FATAL ({e.reason}): {e}", flush=True)
+        code = _announce(e.exit_code)
+        if isinstance(e, exitcodes.PeerDeathError):
+            # A normal interpreter exit runs the JAX distributed
+            # client's shutdown barrier — with a DEAD peer it can never
+            # complete, and the client aborts the process (SIGABRT),
+            # destroying the exit code the requeue wrapper keys on.
+            # Everything durable (emergency snapshot, tombstone,
+            # telemetry) is already on disk: hard-exit past the hook.
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(code)
+        return code
+    except ValueError as e:
+        # Engine/config validation: rerunning the same flags reproduces
+        # the failure — never requeue-retryable.
+        print(f"FATAL (fatal-config): {e}", flush=True)
+        return _announce(exitcodes.FATAL_CONFIG)
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+        return _announce(exitcodes.FATAL_EXCEPTION)
+    if summary.get("preempted"):
+        # Clean checkpoint-and-exit (SIGTERM notice or the watchdog's
+        # clean path): the mid-epoch checkpoint is durable, --resume
+        # continues from it.
+        return _announce(exitcodes.PREEMPTED)
+    return exitcodes.OK
 
 
 if __name__ == "__main__":

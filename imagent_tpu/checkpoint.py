@@ -50,7 +50,8 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
-from imagent_tpu.resilience import faultinject, integrity
+from imagent_tpu.resilience import deadman, faultinject, integrity
+from imagent_tpu.resilience.retry import retry_call
 from imagent_tpu.train import TrainState, host_snapshot, snapshotable
 
 BEST = "best"
@@ -282,6 +283,9 @@ def _commit(ckpt_dir: str, name: str, meta: dict,
     if jax.process_index() == 0:
         _commit_files(ckpt_dir, name, meta, keep_last_k)
     if jax.process_count() > 1:
+        # A degraded pod must not file into the barrier: the dead peer
+        # never arrives and the survivors hang until walltime.
+        deadman.raise_if_degraded()
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(f"ckpt_commit_{name}")
 
@@ -496,7 +500,15 @@ def _commit_snapshot(ckpt_dir: str, name: str, host_state, meta: dict,
     window = {"start": time.time(), "end": None, "ok": None}
     staging = os.path.join(ckpt_dir, name + _STAGING)
     try:
-        _write_snapshot(staging, host_state, meta)
+        # Bounded backoff on the serialization: a briefly-unavailable
+        # NFS mount costs a few retries, not the generation. A storage
+        # outage that outlives the budget fails the commit VERDICT (the
+        # previous generation stays live); the engine exits retryable
+        # after a streak of those (engine._MAX_CKPT_FAIL_STREAK).
+        retry_call(_write_snapshot, staging, host_state, meta,
+                   attempts=3, base_delay=0.5, max_delay=5.0,
+                   retry_on=(OSError,),
+                   describe=f"checkpoint snapshot write ('{name}')")
         _commit_files(ckpt_dir, name, meta, keep_last_k,
                       manifest_in_thread=True)
         result = {"ok": True, "error": ""}
@@ -548,6 +560,9 @@ def poll_async(block: bool = False) -> dict | None:
     else:
         code, secs = 0.0, 0.0
     if jax.process_count() > 1:
+        # Degraded pod: the verdict broadcast would block on the dead
+        # peer forever — bail to the degraded exit ramp instead.
+        deadman.raise_if_degraded()
         # Non-zero processes' inputs are ignored by the broadcast; they
         # block in the collective until process 0 (joining its thread
         # under `block`) arrives with the authoritative verdict.
@@ -657,6 +672,67 @@ def wait_until_finished() -> dict | None:
     _land_pending()
     _join_manifest()
     return landed
+
+
+def save_emergency(ckpt_dir: str, name: str, state: TrainState,
+                   meta: dict, keep_last_k: int = 0) -> bool:
+    """Process 0's DEGRADED-POD save: commit ``state`` as ``name`` with
+    **no collectives and no barriers** — the flat snapshot format was
+    designed for exactly this moment (pure local file I/O, restorable
+    by a requeued pod of any size via the normal ``restore`` path).
+
+    Called from the engine's peer-death exit ramp with a state whose
+    producing steps are known to have retired cleanly (the salvage
+    contract on ``exitcodes.PeerDeathError``). Returns True when the
+    snapshot landed; every failure mode is a warn-and-False — with the
+    pod already degraded, the last committed generation standing is an
+    acceptable outcome, a hang here is not:
+
+    * an async committer thread still running is joined with a bounded
+      timeout (it is local-only; if it is wedged on dead storage the
+      emergency write would wedge the same way, so give up);
+    * a state with leaves genuinely sharded across hosts (multi-host
+      FSDP/TP) cannot be assembled without the dead peer — give up.
+    """
+    global _commit_thread, _commit_result, _commit_started_at, \
+        _async_outstanding
+    import shutil
+
+    if jax.process_index() != 0:
+        return False
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    t = _commit_thread
+    if t is not None:
+        t.join(timeout=30.0)
+        if t.is_alive():
+            print("WARNING: emergency snapshot abandoned: the async "
+                  "committer thread is wedged (dead storage?); the "
+                  "last committed generation stands", flush=True)
+            return False
+        _commit_thread = None
+        _commit_started_at = None
+        _commit_result = None
+        _async_outstanding = False
+    if not snapshotable(state):
+        print("WARNING: emergency snapshot impossible: state leaves "
+              "are sharded across hosts (FSDP/TP) and reassembly "
+              "needs the dead peer; the last committed generation "
+              "stands", flush=True)
+        return False
+    snap = host_snapshot(state)
+    staging = os.path.join(ckpt_dir, name + _STAGING)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    _write_pending_marker(ckpt_dir, name, meta)
+    try:
+        _write_snapshot(staging, snap, meta)
+        _commit_files(ckpt_dir, name, meta, keep_last_k)
+    except BaseException:
+        # The previous generation must survive an emergency gone wrong.
+        shutil.rmtree(staging, ignore_errors=True)
+        _clear_pending_marker(ckpt_dir, name)
+        raise
+    _join_manifest()  # the process is about to exit: full durability
+    return True
 
 
 def save(ckpt_dir: str, name: str, state: TrainState, meta: dict,
@@ -1016,6 +1092,7 @@ def _verified_globally(ckpt_dir: str, cand: str) -> tuple[bool, str]:
     serialize minutes of redundant I/O into every requeue.)"""
     if jax.process_count() == 1:
         return integrity.verify(ckpt_dir, cand)
+    deadman.raise_if_degraded()
     from jax.experimental import multihost_utils
     if jax.process_index() == 0:
         ok, detail = integrity.verify(ckpt_dir, cand)
@@ -1039,6 +1116,9 @@ def _pod_agree(ok: bool) -> bool:
     """
     if jax.process_count() == 1:
         return ok
+    # The whole point of the out-of-band deadman: this min-reduce is
+    # where a survivor would otherwise block forever on a dead peer.
+    deadman.raise_if_degraded()
     from jax.experimental import multihost_utils
     flags = multihost_utils.process_allgather(
         np.asarray([1 if ok else 0], np.int32))
@@ -1059,6 +1139,7 @@ def _pod_candidates(ckpt_dir: str, name: str) -> list[str]:
     everyone."""
     if jax.process_count() == 1:
         return fallback_candidates(ckpt_dir, name)
+    deadman.raise_if_degraded()
     from jax.experimental import multihost_utils
     buf = np.zeros(_CANDIDATE_WIRE_BYTES, np.uint8)
     if jax.process_index() == 0:

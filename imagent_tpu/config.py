@@ -158,6 +158,15 @@ class Config:
     # "nan-grads:after=4;times=4,stall-step:secs=6"
     # (resilience/faultinject.py; also via IMAGENT_FAULTS env var).
     faults: str = ""
+    # Out-of-band partial-pod-failure detection (resilience/heartbeat +
+    # deadman): each host writes a heartbeat record to
+    # <log_dir>/heartbeats/ and monitors its peers with NO collectives;
+    # a peer stale past this deadline (or leaving a fatal tombstone)
+    # degrades the pod — emergency snapshot, retryable exit, launcher
+    # requeue onto --resume. 0 = off. Must be >= 2x --heartbeat-secs.
+    peer_deadline_secs: float = 0.0
+    # Heartbeat write cadence for the mesh above.
+    heartbeat_secs: float = 2.0
 
     # ---- mesh geometry / parallelism strategies ----
     # Data-parallel size is inferred (devices / model_parallel). A model axis
@@ -371,6 +380,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="arm fault-injection drill points, e.g. "
                         "'nan-grads:after=4;times=4' (see "
                         "resilience/faultinject.py)")
+    p.add_argument("--peer-deadline-secs", type=float,
+                   default=c.peer_deadline_secs,
+                   help="declare a pod peer dead when its out-of-band "
+                        "heartbeat is stale this long: emergency "
+                        "snapshot + retryable exit for the launcher "
+                        "requeue (0 = off; >= 2x --heartbeat-secs)")
+    p.add_argument("--heartbeat-secs", type=float,
+                   default=c.heartbeat_secs,
+                   help="per-host heartbeat write cadence for the "
+                        "peer deadman (default 2s)")
     p.add_argument("--model-parallel", type=int, default=c.model_parallel)
     p.add_argument("--seq-parallel", type=str, default=c.seq_parallel,
                    choices=["none", "ring", "ulysses"])

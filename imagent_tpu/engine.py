@@ -39,7 +39,9 @@ from imagent_tpu.data.prefetch import (
     Prefetcher, PrefetchStats, device_prefetch,
 )
 from imagent_tpu.models import create_model
-from imagent_tpu.resilience import faultinject
+from imagent_tpu.resilience import deadman as deadman_lib
+from imagent_tpu.resilience import exitcodes, faultinject
+from imagent_tpu.resilience.deadman import PodHeartbeat
 from imagent_tpu.resilience.watchdog import StepWatchdog
 from imagent_tpu.schedule import lr_for_epoch
 from imagent_tpu.telemetry import TelemetrySession, parse_profile_at_step
@@ -225,6 +227,7 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                     watchdog: StepWatchdog | None = None,
                     telem: TelemetrySession | None = None,
                     prefetch: Prefetcher | None = None,
+                    pod: PodHeartbeat | None = None,
                     ) -> tuple[TrainState, dict, float, int, bool,
                                Prefetcher | None]:
     """One training epoch (reference ``train()``, ``imagenet.py:97-151``).
@@ -257,6 +260,16 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
     two host timestamps around the dispatch (goodput attribution +
     step-cadence sampling) plus an int comparison for the profiler
     window — the same zero-device-sync discipline as the guard above.
+
+    ``pod`` (resilience/deadman.PodHeartbeat): per step, the heartbeat
+    frontier is noted (lock + two int stores — host-only, same cost
+    class as the telemetry sampler) and the DEGRADED flag is read
+    twice: once at the loop top and once immediately before the
+    dispatch (a fault/stall may have slept past a peer's death in
+    between). A degraded pod raises ``exitcodes.PeerDeathError``
+    BEFORE this host files into another collective the dead peer will
+    never complete — carrying the current (clean, fully-retired under
+    the raise conditions) state as salvage for the emergency snapshot.
     """
     t0 = time.time()
     data_time = AverageMeter("data")
@@ -298,6 +311,10 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
         # with the running step, data/prefetch.py; --prefetch-depth).
         for i, arrays in enumerate(prefetch_iter):
             step_i = start_step + i
+            if pod is not None:
+                pod.note(epoch=epoch, step=step_i, phase="train")
+                pod.raise_if_degraded(state=state, epoch=epoch - 1,
+                                      resume_step=steps_done)
             if _stop_agreed(stop_check, step_i):
                 interrupted_at = steps_done
                 break
@@ -316,6 +333,21 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                     images = images * jnp.float32(np.nan)
                 if faultinject.fire("sigterm") is not None:
                     os.kill(os.getpid(), signal.SIGTERM)
+                f = faultinject.fire("host.die")
+                if f is not None:
+                    # Abrupt host loss (VM reclaim / kernel panic
+                    # stand-in): no tombstone, no cleanup, no flushes —
+                    # peers must detect THIS via heartbeat staleness
+                    # alone (resilience/deadman.py).
+                    print("FAULT host.die: hard-exiting this host now",
+                          flush=True)
+                    os._exit(int(f.get("code", 1)))
+            if pod is not None:
+                # Re-check right before the dispatch: the stall/fault
+                # window above (or a long input wait) may have slept
+                # across a peer's death — never enter the collective.
+                pod.raise_if_degraded(state=state, epoch=epoch - 1,
+                                      resume_step=steps_done)
             if telem is not None:
                 telem.profile_step(
                     epoch * loader.steps_per_epoch + step_i)
@@ -557,7 +589,17 @@ def run(cfg: Config, stop_check=None) -> dict:
     (hung collective, stuck input pipeline) dumps all-thread stacks,
     checkpoints LAST, and exits cleanly for the scheduler to requeue.
     Fault drills: ``--faults`` / ``IMAGENT_FAULTS`` arm named fault
-    points (resilience/faultinject.py)."""
+    points (resilience/faultinject.py).
+
+    With ``--peer-deadline-secs`` the out-of-band heartbeat mesh runs
+    for the whole call (resilience/heartbeat + deadman): this host
+    beats into ``<log_dir>/heartbeats/`` and watches its peers with no
+    collectives; a dead peer degrades the pod — the loops stop
+    entering collectives at the next check, process 0 lands a
+    collective-free emergency snapshot, and the run raises
+    ``exitcodes.PeerDeathError`` (exit code 87, retryable) for the
+    launcher's requeue wrapper. Every fatal exit path leaves a
+    tombstone record peers classify instantly."""
     # cfg.backend selects the PJRT platform: "tpu" = runtime auto-select;
     # "cpu"/"gpu" are forced, overriding any environment preset.
     senv = cluster.initialize(cfg.backend or None)
@@ -565,6 +607,25 @@ def run(cfg: Config, stop_check=None) -> dict:
     if faultinject.active() and jax.process_index() == 0:
         print(f"FAULT DRILL: fault points armed ({cfg.faults or 'env'})",
               flush=True)
+    if cfg.peer_deadline_secs < 0:
+        raise ValueError("--peer-deadline-secs must be >= 0 (0 = off)")
+    pod = None
+    if cfg.peer_deadline_secs > 0:
+        if cfg.heartbeat_secs <= 0:
+            raise ValueError("--heartbeat-secs must be > 0 when the "
+                             "peer deadman is armed")
+        if cfg.peer_deadline_secs < 2.0 * cfg.heartbeat_secs:
+            raise ValueError(
+                f"--peer-deadline-secs ({cfg.peer_deadline_secs:g}) "
+                f"must be >= 2x --heartbeat-secs "
+                f"({cfg.heartbeat_secs:g}): a single missed write "
+                "would read as a host death")
+        pod = PodHeartbeat(cfg.log_dir, jax.process_index(),
+                           jax.process_count(),
+                           deadline_secs=cfg.peer_deadline_secs,
+                           interval_secs=cfg.heartbeat_secs)
+        pod.start()
+        deadman_lib.activate(pod)
     guard = None
     if stop_check is None:
         stop_check = guard = PreemptionGuard()
@@ -573,9 +634,36 @@ def run(cfg: Config, stop_check=None) -> dict:
         watchdog = StepWatchdog(cfg.watchdog_secs)
         base_stop = stop_check
         stop_check = lambda: watchdog.fired or base_stop()  # noqa: E731
+        if pod is not None:
+            # The watchdog's hard-exit leaves a classified tombstone so
+            # peers fail over instantly instead of waiting out the
+            # staleness deadline (shared escalation machinery).
+            watchdog.on_escalate = lambda: pod.tombstone(
+                "watchdog-hard-exit", exitcodes.WATCHDOG_HARD_EXIT,
+                detail="no step progress; main thread never polled")
     try:
-        return _run(cfg, stop_check, senv, watchdog)
+        return _run(cfg, stop_check, senv, watchdog, pod)
+    except exitcodes.FatalRunError as e:
+        # Classified fatal exits (peer death, storage outage, rollback
+        # give-up): the tombstone may already exist from the exit ramp;
+        # the writer's write-once guard keeps the first cause.
+        if pod is not None:
+            pod.tombstone(e.reason, e.exit_code, detail=str(e))
+        raise
+    except ValueError as e:
+        if pod is not None:
+            pod.tombstone("fatal-config", exitcodes.FATAL_CONFIG,
+                          detail=str(e))
+        raise
+    except Exception as e:
+        if pod is not None:
+            pod.tombstone("exception", exitcodes.FATAL_EXCEPTION,
+                          detail=f"{type(e).__name__}: {e}")
+        raise
     finally:
+        if pod is not None:
+            deadman_lib.deactivate()
+            pod.stop()
         if watchdog is not None:
             watchdog.stop()
         if guard is not None:
@@ -587,8 +675,75 @@ def run(cfg: Config, stop_check=None) -> dict:
 # problem, not a transient).
 _MAX_ROLLBACKS = 3
 
+# Consecutive failed async checkpoint commits before the run classifies
+# the storage as dead and exits retryable. Each failed commit already
+# survived the committer's own bounded backoff retries and left the
+# previous generation intact — a streak means the outage outlives the
+# epoch cadence, and a run that can no longer land checkpoints is
+# silently un-resumable (every epoch trained past the last good
+# generation is lost on the next failure).
+_MAX_CKPT_FAIL_STREAK = 3
 
-def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
+
+def _storage_guard(fn, *args, **kwargs):
+    """Run a blocking checkpoint save, classifying storage-level
+    failures (OSError: dir vanished, mount dead, disk full) as the
+    retryable storage-outage exit instead of an anonymous crash. The
+    commit dance guarantees the previous generation survives any
+    failed attempt (checkpoint._commit_files: live is never the write
+    target)."""
+    try:
+        return fn(*args, **kwargs)
+    except OSError as e:
+        raise exitcodes.StorageOutageError(
+            f"checkpoint save failed ({type(e).__name__}: {e}) — "
+            "checkpoint storage looks dead; the previous committed "
+            "generation is intact. Exiting retryable for the launcher "
+            "to requeue onto --resume.") from e
+
+
+def _pod_death_exit(cfg: Config, err, pod, telem, epoch: int,
+                    topo_meta: dict, best_meta: dict,
+                    is_master: bool) -> None:
+    """The degraded-pod exit ramp: everything here is out-of-band —
+    NO collectives, NO barriers (the dead peer would never arrive).
+
+    Process 0 lands the salvage state (if the raise site could vouch
+    for one) as a collective-free flat emergency snapshot committed as
+    LAST — the requeued pod's ``--resume`` restores it through the
+    normal fallback walk. The detection verdict goes to the telemetry
+    event log (``pod_degraded``) and this host's tombstone, so the
+    remaining survivors classify our exit instantly instead of waiting
+    out their own staleness deadlines (detection cascades outward in
+    O(deadline), not O(world x deadline))."""
+    v = dict(err.verdict or {})
+    v["epoch"] = int(epoch)
+    print(f"DEADMAN: {err} — landing what can be landed without "
+          f"collectives and exiting retryable "
+          f"(code {err.exit_code})", flush=True)
+    telem.pod_degraded(v)
+    salvage = err.salvage
+    if salvage is not None and jax.process_index() == 0:
+        meta = {**best_meta, **topo_meta,
+                "epoch": int(salvage["epoch"]),
+                "resume_step": int(salvage["resume_step"])}
+        try:
+            if ckpt_lib.save_emergency(cfg.ckpt_dir, ckpt_lib.LAST,
+                                       salvage["state"], meta,
+                                       keep_last_k=cfg.keep_last_k):
+                print("DEADMAN: emergency snapshot committed as LAST "
+                      f"(epoch {meta['epoch'] + 1}, "
+                      f"resume_step {meta['resume_step']}); --resume "
+                      "restores it", flush=True)
+        except Exception as se:
+            print(f"WARNING: emergency snapshot failed "
+                  f"({type(se).__name__}: {se}); the last committed "
+                  "generation stands", flush=True)
+    if pod is not None:
+        pod.tombstone(err.reason, err.exit_code, detail=str(err))
+
+
+def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
     if cfg.compile_cache:
         jax.config.update("jax_compilation_cache_dir",
                           os.path.abspath(cfg.compile_cache))
@@ -1036,22 +1191,41 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
 
     def _end_telemetry_epoch(ep: int, tm: dict,
                              interrupted: bool = False) -> None:
+        if pod is not None:
+            # telemetry.epoch_end runs the per-host counter allgather —
+            # the same class of dead-peer hang as the checkpoint
+            # collectives. Bare gate (no salvage): some call sites sit
+            # mid-rollback, where the live state must not be vouched
+            # for; the last committed generation stands.
+            pod.raise_if_degraded()
         if watchdog is not None and watchdog.fired:
             telem.count("watchdog_fired")
+        if pod is not None:
+            # High-water peer-heartbeat age this epoch: a value creeping
+            # toward --peer-deadline-secs is a host about to be declared
+            # dead (or a deadline tuned too tight for the fs).
+            telem.gauge("hb_peer_staleness_s",
+                        round(pod.max_peer_staleness(), 3))
         telem.epoch_end(ep, tm, interrupted=interrupted)
 
     ckpt_commit_failures = 0  # pod-agreed failed async commits
+    ckpt_fail_streak = 0      # consecutive — the storage-outage verdict
 
     def _absorb_commit(landed: dict | None) -> None:
         """Attribute a landed async-commit verdict: its duration moves
         to the overlapped ``ckpt_commit_async`` phase (work hidden
         behind compute, NOT part of the wall partition); a pod-agreed
         failure is counted — the previous generation silently remains
-        the last good checkpoint and the next epoch's save retries."""
-        nonlocal ckpt_commit_failures
+        the last good checkpoint and the next epoch's save retries.
+        A STREAK of failures (each already past the committer's own
+        bounded backoff) means the storage outage is not transient:
+        exit retryable while the last good generation is still worth
+        resuming from, instead of training on un-checkpointable."""
+        nonlocal ckpt_commit_failures, ckpt_fail_streak
         if landed is None:
             return
         if landed["ok"]:
+            ckpt_fail_streak = 0
             telem.overlap("ckpt_commit_async", landed["secs"])
             if is_master:
                 print(f"async checkpoint '{landed['name']}' committed "
@@ -1059,7 +1233,16 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
                       "training)", flush=True)
         else:
             ckpt_commit_failures += 1
+            ckpt_fail_streak += 1
             telem.count("ckpt_commit_failed")
+            if ckpt_fail_streak >= _MAX_CKPT_FAIL_STREAK:
+                raise exitcodes.StorageOutageError(
+                    f"{ckpt_fail_streak} consecutive async checkpoint "
+                    f"commits failed (last: {landed['error']}), each "
+                    "past its own backoff retries — checkpoint storage "
+                    "looks dead. The previous good generation is "
+                    "intact; exiting retryable for the launcher to "
+                    "requeue onto --resume.")
 
     if watchdog is not None and cfg.async_ckpt and cfg.save_model:
         # A wedged committer thread (dead storage mount) gets the same
@@ -1072,153 +1255,243 @@ def _run(cfg: Config, stop_check, senv, watchdog) -> dict:
     rollback_streak = 0  # consecutive incidents — the give-up budget
     epoch = start_epoch
     warm = None  # next epoch's pre-started input pipeline
-    while epoch < cfg.epochs:
-        lr = lr_for_epoch(cfg, epoch)
-        telem.epoch_begin()
-        (state, train_m, train_t, interrupted_at, want_rollback,
-         warm) = train_one_epoch(
-            cfg, mesh, train_step, state, train_loader, epoch, lr,
-            is_master, stop_check, resume_step, watchdog, telem,
-            prefetch=warm)
-        resume_step = 0  # only the first resumed epoch skips batches
-        # Land the previous epoch's async checkpoint commit if it has
-        # completed (non-blocking; the verdict is pod-agreed HERE, at
-        # commit completion — checkpoint.poll_async).
-        _absorb_commit(ckpt_lib.poll_async())
-        if not want_rollback:
-            # An epoch got through without tripping the guard: any
-            # earlier incident was genuinely transient. The give-up
-            # budget is per incident-STREAK, not per run — three
-            # isolated recovered transients across 100 epochs must not
-            # kill a healthy job on the fourth.
-            rollback_streak = 0
-        if want_rollback:
-            # --max-bad-steps consecutive non-finite steps: the updates
-            # were all skipped in-graph, so the live state is not
-            # poisoned — but something is persistently wrong (data
-            # shard, numerics). Roll back to the last restorable
-            # checkpoint and replay rather than abort: a transient
-            # (one corrupt shard served once, a flaky host) costs one
-            # checkpoint interval instead of the run.
-            rollbacks += 1
-            rollback_streak += 1
-            telem.count("rollbacks")
-            if rollback_streak > _MAX_ROLLBACKS:
-                raise RuntimeError(
-                    f"non-finite steps persisted through {_MAX_ROLLBACKS} "
-                    "consecutive rollbacks — giving up (check data / lr "
-                    "/ bf16 ranges; the fault reproduces on every replay)")
-            t_rec = time.perf_counter()
-            restored = ckpt_lib.restore_resilient(cfg.ckpt_dir, state)
-            if restored is None:
-                # Nothing to roll back to — but the in-graph guard
-                # skipped every bad update, so the live state is NOT
-                # poisoned. Killing an intact run because --save-model
-                # is off would be strictly worse than pressing on; skip
-                # the rest of this epoch (its remaining batches would
-                # re-fire whatever tripped the guard) and continue,
-                # still bounded by the rollback budget above.
-                if is_master:
-                    print(f"WARNING: {cfg.max_bad_steps} consecutive "
-                          f"non-finite steps in epoch {epoch + 1} and "
-                          "no checkpoint to roll back to (--save-model "
-                          "off?). State is unpoisoned (updates were "
-                          "skipped in-graph); abandoning the rest of "
-                          f"this epoch ({rollback_streak}/"
-                          f"{_MAX_ROLLBACKS} consecutive strikes "
-                          "before giving up)", flush=True)
-                telem.phase("recovery", time.perf_counter() - t_rec)
-                _end_telemetry_epoch(epoch, train_m)
-                epoch += 1
-                continue
-            state, meta, src = restored
-            state = place_state(state, mesh, state_specs)
-            telem.phase("recovery", time.perf_counter() - t_rec)
-            # The record names the epoch that FAILED (the one whose
-            # wall time this was), not the replay target below.
-            _end_telemetry_epoch(epoch, train_m)
-            (epoch, resume_step, best_top1, best_top5,
-             best_epoch) = _resume_point(meta)
-            if is_master:
-                print(f"ROLLBACK {rollback_streak}/{_MAX_ROLLBACKS}: "
-                      f"restored checkpoint '{src}', replaying from "
-                      f"epoch {epoch + 1}"
-                      + (f" step {resume_step}" if resume_step else ""),
-                      flush=True)
-            continue
-        if interrupted_at >= 0:
-            # Preemption: persist the mid-epoch state, recording how many
-            # of this epoch's steps it contains — --resume skips exactly
-            # those batches, so no gradient is applied twice.
-            t_ck = time.perf_counter()
-            ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.LAST, state, {
-                "epoch": epoch - 1, "resume_step": interrupted_at,
-                "best_top1": best_top1, "best_top5": best_top5,
-                "best_epoch": best_epoch, **topo_meta},
-                keep_last_k=cfg.keep_last_k)
-            telem.phase("checkpoint", time.perf_counter() - t_ck)
-            telem.count("preempted")
-            _end_telemetry_epoch(epoch, train_m, interrupted=True)
-            if is_master:
-                print(f"preemption signal: checkpointed epoch {epoch + 1} "
-                      f"at step {interrupted_at}; exiting cleanly "
-                      "(--resume continues from there)", flush=True)
-            preempted = True
-            break
-        did_eval = (epoch + 1) % cfg.eval_every == 0 or epoch == cfg.epochs - 1
-        if did_eval:
-            val_m, val_t = evaluate(cfg, mesh, eval_step, state,
-                                    val_loader, epoch, telem)
-            telem.phase("eval", val_t)
-        else:
-            val_t = 0.0
-        t_ck = time.perf_counter()
-        if did_eval and val_m["top1"] > best_top1:
-            best_top1, best_top5, best_epoch = (
-                val_m["top1"], val_m["top5"], epoch)
-            if cfg.save_model:
-                ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.BEST, state, {
-                    "epoch": epoch, "best_top1": best_top1,
-                    "best_top5": best_top5, "best_epoch": best_epoch,
-                    **topo_meta})
-        if cfg.save_model:
-            last_meta = {"epoch": epoch, "best_top1": best_top1,
-                         "best_top5": best_top5, "best_epoch": best_epoch,
-                         **topo_meta}
-            if cfg.async_ckpt:
-                # Snapshot-then-commit: the only blocking slice is the
-                # device→host copy; serialization + rotation + manifest
-                # hashing run on the committer thread while the next
-                # epoch trains (checkpoint.save_async). If the PREVIOUS
-                # commit was somehow still in flight, landing it blocks
-                # here and its verdict is returned.
-                _absorb_commit(ckpt_lib.save_async(
-                    cfg.ckpt_dir, ckpt_lib.LAST, state, last_meta,
-                    keep_last_k=cfg.keep_last_k))
-            else:
-                # --no-async-ckpt: the fully synchronous baseline
-                # (bench-smoke's reference point) — the loop stalls for
-                # the whole serialize + commit + manifest.
-                ckpt_lib.save(cfg.ckpt_dir, ckpt_lib.LAST, state,
-                              last_meta, block=True,
-                              keep_last_k=cfg.keep_last_k)
-        # The blocking slice only: the host snapshot for the async LAST
-        # (its commit overlaps the next epoch by design) plus any BEST
-        # save — the wall time checkpointing actually cost this epoch.
-        telem.phase("checkpoint", time.perf_counter() - t_ck)
-        if is_master and train_m.get("bad_steps"):
-            print(f"  epoch {epoch + 1}: {train_m['bad_steps']} "
-                  "non-finite step(s) skipped", flush=True)
-        logger.epoch_summary(epoch, lr, train_m,
-                             val_m if did_eval else None, train_t, val_t)
-        logger.scalars(epoch, lr, train_m, val_m if did_eval else None)
-        _end_telemetry_epoch(epoch, train_m)
-        epoch += 1
 
-    # Land any in-flight async save — the final epoch's LAST commit
-    # lands HERE, so its verdict (a failure has no next-epoch retry)
-    # must be absorbed, not dropped.
-    _absorb_commit(ckpt_lib.wait_until_finished())
+    def _pod_gate(phase: str) -> None:
+        """Degraded-pod check before each pod-agreed phase: a dead peer
+        must divert us to the out-of-band exit ramp BEFORE this host
+        files into the phase's collectives. The salvage meta names the
+        last pod-consistent point: mid-epoch when the train loop was
+        interrupted, else the epoch boundary just reached. An epoch
+        that tripped the non-finite rollback verdict vouches for
+        NOTHING — its state is partial and its meta would claim a
+        complete epoch; no salvage, the last committed generation
+        stands (it is what the rollback would have restored anyway)."""
+        if pod is None:
+            return
+        pod.note(phase=phase)
+        if want_rollback:
+            pod.raise_if_degraded()
+        elif interrupted_at >= 0:
+            pod.raise_if_degraded(state=state, epoch=epoch - 1,
+                                  resume_step=interrupted_at)
+        else:
+            pod.raise_if_degraded(state=state, epoch=epoch,
+                                  resume_step=0)
+
+    try:
+        while epoch < cfg.epochs:
+            lr = lr_for_epoch(cfg, epoch)
+            telem.epoch_begin()
+            interrupted_at = -1   # for _pod_gate if the epoch raises
+            want_rollback = False
+            (state, train_m, train_t, interrupted_at, want_rollback,
+             warm) = train_one_epoch(
+                cfg, mesh, train_step, state, train_loader, epoch, lr,
+                is_master, stop_check, resume_step, watchdog, telem,
+                prefetch=warm, pod=pod)
+            resume_step = 0  # only the first resumed epoch skips batches
+            # Land the previous epoch's async checkpoint commit if it
+            # has completed (non-blocking; the verdict is pod-agreed
+            # HERE, at commit completion — checkpoint.poll_async).
+            _pod_gate("boundary")
+            _absorb_commit(ckpt_lib.poll_async())
+            if not want_rollback:
+                # An epoch got through without tripping the guard: any
+                # earlier incident was genuinely transient. The give-up
+                # budget is per incident-STREAK, not per run — three
+                # isolated recovered transients across 100 epochs must
+                # not kill a healthy job on the fourth.
+                rollback_streak = 0
+            if want_rollback:
+                # --max-bad-steps consecutive non-finite steps: the
+                # updates were all skipped in-graph, so the live state
+                # is not poisoned — but something is persistently wrong
+                # (data shard, numerics). Roll back to the last
+                # restorable checkpoint and replay rather than abort: a
+                # transient (one corrupt shard served once, a flaky
+                # host) costs one checkpoint interval instead of the
+                # run.
+                rollbacks += 1
+                rollback_streak += 1
+                telem.count("rollbacks")
+                if rollback_streak > _MAX_ROLLBACKS:
+                    raise exitcodes.RollbackGiveUpError(
+                        f"non-finite steps persisted through "
+                        f"{_MAX_ROLLBACKS} consecutive rollbacks — "
+                        "giving up (check data / lr / bf16 ranges; the "
+                        "fault reproduces on every replay)")
+                t_rec = time.perf_counter()
+                _pod_gate("recovery")
+                restored = ckpt_lib.restore_resilient(cfg.ckpt_dir,
+                                                      state)
+                if restored is None:
+                    # Nothing to roll back to — but the in-graph guard
+                    # skipped every bad update, so the live state is
+                    # NOT poisoned. Killing an intact run because
+                    # --save-model is off would be strictly worse than
+                    # pressing on; skip the rest of this epoch (its
+                    # remaining batches would re-fire whatever tripped
+                    # the guard) and continue, still bounded by the
+                    # rollback budget above.
+                    if is_master:
+                        print(f"WARNING: {cfg.max_bad_steps} "
+                              "consecutive non-finite steps in epoch "
+                              f"{epoch + 1} and no checkpoint to roll "
+                              "back to (--save-model off?). State is "
+                              "unpoisoned (updates were skipped "
+                              "in-graph); abandoning the rest of this "
+                              f"epoch ({rollback_streak}/"
+                              f"{_MAX_ROLLBACKS} consecutive strikes "
+                              "before giving up)", flush=True)
+                    telem.phase("recovery", time.perf_counter() - t_rec)
+                    _end_telemetry_epoch(epoch, train_m)
+                    epoch += 1
+                    continue
+                state, meta, src = restored
+                state = place_state(state, mesh, state_specs)
+                telem.phase("recovery", time.perf_counter() - t_rec)
+                # The record names the epoch that FAILED (the one whose
+                # wall time this was), not the replay target below.
+                _end_telemetry_epoch(epoch, train_m)
+                (epoch, resume_step, best_top1, best_top5,
+                 best_epoch) = _resume_point(meta)
+                if is_master:
+                    print(f"ROLLBACK {rollback_streak}/{_MAX_ROLLBACKS}"
+                          f": restored checkpoint '{src}', replaying "
+                          f"from epoch {epoch + 1}"
+                          + (f" step {resume_step}" if resume_step
+                             else ""),
+                          flush=True)
+                continue
+            if interrupted_at >= 0:
+                # Preemption: persist the mid-epoch state, recording
+                # how many of this epoch's steps it contains —
+                # --resume skips exactly those batches, so no gradient
+                # is applied twice.
+                t_ck = time.perf_counter()
+                _pod_gate("checkpoint")
+                _storage_guard(
+                    ckpt_lib.save, cfg.ckpt_dir, ckpt_lib.LAST, state, {
+                        "epoch": epoch - 1,
+                        "resume_step": interrupted_at,
+                        "best_top1": best_top1, "best_top5": best_top5,
+                        "best_epoch": best_epoch, **topo_meta},
+                    keep_last_k=cfg.keep_last_k)
+                telem.phase("checkpoint", time.perf_counter() - t_ck)
+                telem.count("preempted")
+                _end_telemetry_epoch(epoch, train_m, interrupted=True)
+                if is_master:
+                    print("preemption signal: checkpointed epoch "
+                          f"{epoch + 1} at step {interrupted_at}; "
+                          "exiting cleanly (--resume continues from "
+                          "there)", flush=True)
+                preempted = True
+                break
+            did_eval = ((epoch + 1) % cfg.eval_every == 0
+                        or epoch == cfg.epochs - 1)
+            if did_eval:
+                _pod_gate("eval")
+                val_m, val_t = evaluate(cfg, mesh, eval_step, state,
+                                        val_loader, epoch, telem)
+                telem.phase("eval", val_t)
+            else:
+                val_t = 0.0
+            t_ck = time.perf_counter()
+            _pod_gate("checkpoint")
+            if did_eval and val_m["top1"] > best_top1:
+                best_top1, best_top5, best_epoch = (
+                    val_m["top1"], val_m["top5"], epoch)
+                if cfg.save_model:
+                    _storage_guard(
+                        ckpt_lib.save, cfg.ckpt_dir, ckpt_lib.BEST,
+                        state, {
+                            "epoch": epoch, "best_top1": best_top1,
+                            "best_top5": best_top5,
+                            "best_epoch": best_epoch, **topo_meta})
+            if cfg.save_model:
+                last_meta = {"epoch": epoch, "best_top1": best_top1,
+                             "best_top5": best_top5,
+                             "best_epoch": best_epoch, **topo_meta}
+                if cfg.async_ckpt:
+                    # Snapshot-then-commit: the only blocking slice is
+                    # the device→host copy; serialization + rotation +
+                    # manifest hashing run on the committer thread
+                    # while the next epoch trains
+                    # (checkpoint.save_async). If the PREVIOUS commit
+                    # was somehow still in flight, landing it blocks
+                    # here and its verdict is returned.
+                    _absorb_commit(_storage_guard(
+                        ckpt_lib.save_async,
+                        cfg.ckpt_dir, ckpt_lib.LAST, state, last_meta,
+                        keep_last_k=cfg.keep_last_k))
+                else:
+                    # --no-async-ckpt: the fully synchronous baseline
+                    # (bench-smoke's reference point) — the loop stalls
+                    # for the whole serialize + commit + manifest.
+                    _storage_guard(
+                        ckpt_lib.save, cfg.ckpt_dir, ckpt_lib.LAST,
+                        state, last_meta, block=True,
+                        keep_last_k=cfg.keep_last_k)
+            # The blocking slice only: the host snapshot for the async
+            # LAST (its commit overlaps the next epoch by design) plus
+            # any BEST save — the wall time checkpointing actually
+            # cost this epoch.
+            telem.phase("checkpoint", time.perf_counter() - t_ck)
+            if is_master and train_m.get("bad_steps"):
+                print(f"  epoch {epoch + 1}: {train_m['bad_steps']} "
+                      "non-finite step(s) skipped", flush=True)
+            logger.epoch_summary(epoch, lr, train_m,
+                                 val_m if did_eval else None, train_t,
+                                 val_t)
+            logger.scalars(epoch, lr, train_m,
+                           val_m if did_eval else None)
+            _end_telemetry_epoch(epoch, train_m)
+            epoch += 1
+
+        # Land any in-flight async save — the final epoch's LAST commit
+        # lands HERE, so its verdict (a failure has no next-epoch
+        # retry) must be absorbed, not dropped.
+        _absorb_commit(ckpt_lib.wait_until_finished())
+    except exitcodes.PeerDeathError as e:
+        _pod_death_exit(cfg, e, pod, telem, epoch, topo_meta,
+                        {"best_top1": best_top1, "best_top5": best_top5,
+                         "best_epoch": best_epoch}, is_master)
+        raise
+    except exitcodes.FatalRunError:
+        raise
+    except Exception as exc:
+        # A one-sided collective blow-up (gloo abort, ICI timeout,
+        # XlaRuntimeError) is very often the SYMPTOM of a peer death
+        # whose heartbeat has not yet crossed the deadline: hold the
+        # exception for one deadline and let the out-of-band verdict
+        # classify it. No salvage — a state whose producing step blew
+        # up cannot be vouched for; the last committed generation
+        # stands.
+        if pod is not None and not pod.degraded:
+            pod.wait_verdict(cfg.peer_deadline_secs
+                             + 2.0 * cfg.heartbeat_secs)
+        if pod is not None and pod.degraded:
+            err = exitcodes.PeerDeathError(
+                f"run exception attributed to a dead peer "
+                f"({type(exc).__name__}: {exc})", verdict=pod.verdict)
+            _pod_death_exit(cfg, err, pod, telem, epoch, topo_meta,
+                            {"best_top1": best_top1,
+                             "best_top5": best_top5,
+                             "best_epoch": best_epoch}, is_master)
+            raise err from exc
+        raise
+    if preempted and pod is not None:
+        # Clean checkpoint-and-exit still classifies itself for the
+        # peers' monitors (and the requeue wrapper reads the matching
+        # exit code from __main__): preemption and the watchdog's
+        # clean path are both retryable.
+        if watchdog is not None and watchdog.fired:
+            pod.tombstone("watchdog-stall", exitcodes.PREEMPTED,
+                          detail="stalled steps; clean "
+                                 "checkpoint-and-exit")
+        else:
+            pod.tombstone("preempted", exitcodes.PREEMPTED,
+                          detail="preemption checkpoint-and-exit")
     if cfg.profile and is_master:
         jax.profiler.stop_trace()
     if not preempted:

@@ -1,0 +1,62 @@
+#!/bin/bash
+# Requeue wrapper: run the training command; when it dies with a
+# RETRYABLE exit code (resilience/exitcodes.py — preemption 75,
+# watchdog hard-exit 86, deadman peer-death 87, storage outage 88),
+# restart it with --resume after an exponential backoff, bounded by a
+# restart budget. Non-retryable codes (config errors, reproducible
+# faults) and an exhausted budget exit immediately with the original
+# code, so a broken invocation never crash-loops.
+#
+# Used as the per-task command under both launchers (slurm_tpu.sh's
+# srun line, tpu_pod.sh's worker fan-out): every host of a degraded
+# pod exits retryable within seconds of a peer death (the deadman
+# makes the failure pod-wide and fast), so all tasks fall into this
+# loop together, back off, and re-rendezvous onto --resume — the
+# whole-pod requeue without scheduler support.
+#
+# Usage: requeue.sh <command...>
+# Env knobs:
+#   IMAGENT_RESTART_BUDGET   max restarts (default 3)
+#   IMAGENT_RESTART_BACKOFF  base backoff seconds, doubling per
+#                            restart, capped at 300 (default 5)
+#   IMAGENT_RETRYABLE_CODES  space-separated override of the retryable
+#                            set. The default below is a literal (this
+#                            script must work when Python cannot even
+#                            start) and is pinned against
+#                            resilience/exitcodes.retryable_codes() by
+#                            tests/test_launch.py.
+set -u
+
+BUDGET="${IMAGENT_RESTART_BUDGET:-3}"
+BACKOFF="${IMAGENT_RESTART_BACKOFF:-5}"
+RETRYABLE="${IMAGENT_RETRYABLE_CODES:-75 86 87 88}"
+
+attempt=0
+while :; do
+  if [ "${attempt}" -eq 0 ]; then
+    "$@"
+  else
+    # Later occurrences override: --resume is additive and idempotent.
+    "$@" --resume
+  fi
+  rc=$?
+  [ "${rc}" -eq 0 ] && exit 0
+
+  retry=0
+  for code in ${RETRYABLE}; do
+    [ "${rc}" -eq "${code}" ] && retry=1
+  done
+  if [ "${retry}" -ne 1 ]; then
+    echo "requeue: exit ${rc} is not retryable; giving up" >&2
+    exit "${rc}"
+  fi
+  if [ "${attempt}" -ge "${BUDGET}" ]; then
+    echo "requeue: restart budget (${BUDGET}) exhausted after exit ${rc}" >&2
+    exit "${rc}"
+  fi
+  attempt=$((attempt + 1))
+  delay=$((BACKOFF * (1 << (attempt - 1))))
+  [ "${delay}" -gt 300 ] && delay=300
+  echo "requeue: retryable exit ${rc}; restart ${attempt}/${BUDGET} with --resume in ${delay}s" >&2
+  sleep "${delay}"
+done

@@ -27,11 +27,20 @@
 
 cd "${SLURM_SUBMIT_DIR}"
 
-srun python -m imagent_tpu \
+# Per-task requeue wrapper (launch/requeue.sh): a task exiting with a
+# retryable code — preemption 75, watchdog hard-exit 86, deadman
+# peer-death 87, storage outage 88 (resilience/exitcodes.py) — is
+# restarted with --resume after a backoff, bounded by
+# IMAGENT_RESTART_BUDGET. The deadman (--peer-deadline-secs) makes a
+# partial-pod failure fail FAST on every survivor, so all tasks drop
+# into the wrapper together and re-rendezvous onto the last good
+# checkpoint — no walltime burned in a half-dead allreduce.
+srun bash imagent_tpu/launch/requeue.sh python -m imagent_tpu \
   --backend=tpu \
   --arch=resnet50 \
   --batch-size=128 \
   --epochs=90 \
   --lr=0.1 \
   --data-root=/data/imagenet \
+  --peer-deadline-secs=60 \
   --save-model "$@"

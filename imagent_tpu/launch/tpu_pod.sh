@@ -14,5 +14,11 @@ set -euo pipefail
 TPU_NAME="$1"; shift
 ZONE="$1"; shift
 
+# Each worker runs under the requeue wrapper: retryable exits
+# (preemption, watchdog hard-exit, deadman peer-death, storage outage —
+# resilience/exitcodes.py) restart that worker's command with --resume
+# after a backoff; the deadman (--peer-deadline-secs) makes any
+# partial-pod failure fail fast on every survivor so the whole pod
+# re-rendezvouses together.
 gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
-  --command "cd ~/imagent_tpu && python -m imagent_tpu --backend=tpu $*"
+  --command "cd ~/imagent_tpu && bash imagent_tpu/launch/requeue.sh python -m imagent_tpu --backend=tpu --peer-deadline-secs=60 $*"

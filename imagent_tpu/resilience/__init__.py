@@ -17,9 +17,18 @@ everything on any rank failure — SURVEY §5 "Failure detection",
   within a deadline (hung collective, wedged input pipeline);
 * ``integrity`` — per-file checksum manifests for checkpoint
   directories, verified on restore so a torn write or bit-rot falls
-  back to an older good checkpoint instead of stranding the run.
+  back to an older good checkpoint instead of stranding the run;
+* ``heartbeat`` / ``deadman`` — the out-of-band partial-pod-failure
+  layer: per-host heartbeat records + fatal tombstones in a shared
+  directory, a jax-free peer monitor that trips the pod DEGRADED when
+  a heartbeat goes stale past ``--peer-deadline-secs``, gates every
+  collective entry point, lands process 0's collective-free emergency
+  snapshot, and exits retryable for the launcher's requeue wrapper;
+* ``exitcodes`` — the process exit-code taxonomy (which deliberate
+  exits exist and which are requeue-retryable), replacing inline ints
+  at the ``os._exit``/``sys.exit`` sites.
 
-The fourth pillar — the non-finite step guard — lives in the jitted
+The remaining pillar — the non-finite step guard — lives in the jitted
 step itself (``train.py``: bad updates are skipped in-graph, the flag
 rides the per-step metric vector as ``n == 0``) with the rollback
 policy in ``engine.py``.
@@ -28,3 +37,6 @@ policy in ``engine.py``.
 from imagent_tpu.resilience import faultinject  # noqa: F401
 from imagent_tpu.resilience.retry import retry_call  # noqa: F401
 from imagent_tpu.resilience.watchdog import StepWatchdog  # noqa: F401
+from imagent_tpu.resilience import exitcodes  # noqa: F401
+from imagent_tpu.resilience import heartbeat  # noqa: F401
+from imagent_tpu.resilience import deadman  # noqa: F401

@@ -1,0 +1,393 @@
+"""Deadman monitor: out-of-band peer-death detection + escalation.
+
+The counterpart of ``resilience/heartbeat.py``: a background thread on
+every host watches every PEER's heartbeat file — no collectives, no
+JAX, pure local file reads of the shared heartbeat directory — and
+trips the pod into the DEGRADED state when a peer's heartbeat goes
+stale past ``--peer-deadline-secs`` or a fresh fatal tombstone
+appears. From that moment the contract is *fail fast, together*:
+
+* the engine's step loop and epoch-boundary checks consult
+  ``degraded`` (a plain flag read, free) BEFORE entering any new
+  collective and raise ``exitcodes.PeerDeathError`` instead — a
+  survivor must never file into a reduce whose peer will not arrive;
+* every collective entry point in ``checkpoint.py`` (``_pod_agree``,
+  the verdict broadcasts, the commit barrier) calls this module's
+  ``raise_if_degraded`` first, so even a restore/save already in
+  flight bails out instead of blocking forever;
+* the engine's degraded-exit ramp lands process 0's collective-free
+  flat emergency snapshot and exits with the retryable
+  ``exitcodes.PEER_DEAD`` so the launcher's requeue wrapper restarts
+  the whole pod onto ``--resume``.
+
+Escalation (shared machinery with ``resilience/watchdog.py``): tripping
+the flag only helps if the main thread is alive to see it. If it never
+acknowledges within a grace window — it is wedged inside a collective
+the dead peer will never complete — the monitor dumps every thread's
+stack (the watchdog's ``dump_all_stacks``), writes this host's own
+``peer-dead`` tombstone (so the NEXT ring of survivors classifies
+instantly), and hard-exits ``os._exit(PEER_DEAD)``. Either way the
+host is gone on a retryable code within seconds-to-a-minute of the
+peer's death, not at walltime.
+
+Judgment rules (requeue hygiene):
+
+* A peer is judged stale only from the monitor's OWN observation clock
+  (monotonic time since the record last *changed* locally) — never
+  from the wall clock inside the record, so cross-host clock skew
+  cannot fabricate a death.
+* A peer whose last beat carries ``phase == "done"`` departed cleanly
+  and is never judged.
+* A tombstone counts only if it is fresh (written after this monitor
+  started, with 1s skew slack) or the peer was seen alive this run —
+  a leftover from the previous attempt must not crash-loop the requeue
+  (writers also delete their own leftovers at start).
+* A peer that NEVER produced a heartbeat is not judged: rendezvous
+  failures are ``jax.distributed.initialize``'s timeout to report, and
+  the writer lands its first beat before the engine does any work, so
+  the unobserved window is negligible.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from imagent_tpu.resilience import heartbeat
+from imagent_tpu.resilience import exitcodes
+from imagent_tpu.resilience.watchdog import dump_all_stacks
+
+# The active pod-health object engine.run installs; checkpoint.py's
+# collective gates consult it through raise_if_degraded() below so the
+# plumbing never has to thread a handle through every call chain.
+_ACTIVE = None
+
+
+def activate(pod) -> None:
+    """Install ``pod`` (anything with ``raise_if_degraded()``) as the
+    process-global pod-health gate."""
+    global _ACTIVE
+    _ACTIVE = pod
+
+
+def deactivate() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def raise_if_degraded() -> None:
+    """Module-level gate for collective entry points: raises
+    ``exitcodes.PeerDeathError`` when the active monitor has declared
+    the pod degraded; no-op (and free) when no monitor is installed."""
+    pod = _ACTIVE
+    if pod is not None:
+        pod.raise_if_degraded()
+
+
+class DeadmanMonitor:
+    """Watch peer heartbeats; trip ``degraded``; escalate if unheeded.
+
+    ``ack()`` (called automatically by ``raise_if_degraded`` when it
+    raises) tells the monitor the main thread has seen the verdict and
+    is on the clean exit ramp — the escalation deadline is PUSHED (not
+    cancelled): if the ramp itself wedges (the emergency snapshot's
+    device fetch waits on a dead collective), the hard-exit still
+    fires one grace window later.
+    """
+
+    def __init__(self, hb_dir: str, rank: int, world: int,
+                 deadline_secs: float, escalate_secs: float | None = None,
+                 tombstone_cb=None, out=None, _exit=os._exit):
+        if deadline_secs <= 0:
+            raise ValueError("peer deadline must be positive")
+        self.hb_dir = hb_dir
+        self.rank = int(rank)
+        self.world = int(world)
+        self.deadline = float(deadline_secs)
+        self.degraded = False
+        self.verdict: dict | None = None
+        self._escalate_window = (float(escalate_secs)
+                                 if escalate_secs is not None
+                                 else max(2.0 * self.deadline, 30.0))
+        self._escalate_at: float | None = None
+        self._tombstone_cb = tombstone_cb
+        self._out = out
+        self._exit = _exit
+        self._t0_wall = time.time()
+        self._t0_mono = time.monotonic()
+        self._scan_warned = False
+        self._unobserved_warned = False
+        self._observed_any = False
+        # Per-peer observation state: last record signature, the
+        # monotonic instant it last changed, whether we ever saw it
+        # change (alive this run), and the clean-departure marker.
+        self._peers = {r: {"sig": None, "changed_at": None,
+                           "alive": False, "done": False}
+                       for r in range(self.world) if r != self.rank}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        poll = min(max(self.deadline / 8.0, 0.05), 1.0)
+        self._thread = threading.Thread(
+            target=self._watch, args=(poll,),
+            name=f"deadman-{self.rank}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # ---- main-thread surface -------------------------------------------
+
+    def ack(self) -> None:
+        """The main thread saw the verdict; push the hard-exit out one
+        grace window while the clean exit ramp runs."""
+        with self._lock:
+            self._escalate_at = time.monotonic() + self._escalate_window
+
+    def raise_if_degraded(self, state=None, epoch: int = 0,
+                          resume_step: int = 0) -> None:
+        """Raise ``PeerDeathError`` if the pod is degraded; otherwise
+        free (one attribute read). ``state`` (optional) rides the
+        exception as salvage — a known-clean TrainState the degraded
+        exit ramp lands as the emergency snapshot with meta
+        ``{"epoch": epoch, "resume_step": resume_step}``."""
+        if not self.degraded:
+            return
+        self.ack()
+        v = dict(self.verdict or {})
+        salvage = None
+        if state is not None:
+            salvage = {"state": state, "epoch": int(epoch),
+                       "resume_step": int(resume_step)}
+        ts = v.get("tombstone") or {}
+        why = (f"tombstone: {ts.get('reason', '?')}" if ts
+               else f"heartbeat stale {v.get('stale_for_s', 0.0):.1f}s "
+                    f"> deadline {self.deadline:.1f}s")
+        # A tombstone classifying a NON-retryable death (reproducing
+        # exception, config error) is adopted pod-wide: that peer will
+        # never rejoin a requeued rendezvous, so exiting retryable
+        # here would only burn the restart budget on timeouts.
+        code = self.exit_code_for_verdict()
+        if code != exitcodes.PEER_DEAD:
+            why += " — NON-retryable on the peer; adopting its verdict"
+        raise exitcodes.PeerDeathError(
+            f"pod peer host {v.get('peer')} is dead ({why})",
+            verdict=v, salvage=salvage, exit_code=code)
+
+    def exit_code_for_verdict(self) -> int:
+        """The code this host should die with for the current verdict:
+        PEER_DEAD (retryable) normally; the peer's own classification
+        when its tombstone declared the death NON-retryable."""
+        ts = (self.verdict or {}).get("tombstone") or {}
+        if ts.get("retryable") is False:
+            return int(ts.get("exit_code", exitcodes.FATAL_EXCEPTION))
+        return exitcodes.PEER_DEAD
+
+    def wait_verdict(self, timeout: float) -> dict | None:
+        """Block up to ``timeout`` for a peer-death verdict — the
+        exception-path classifier: a collective that just blew up
+        one-sided is very often the SYMPTOM of a peer death whose
+        heartbeat has not yet crossed the deadline."""
+        t_end = time.monotonic() + max(timeout, 0.0)
+        while not self.degraded and time.monotonic() < t_end:
+            time.sleep(0.05)
+        return self.verdict if self.degraded else None
+
+    def max_peer_staleness(self) -> float:
+        """Age of the stalest live peer heartbeat (telemetry gauge)."""
+        now = time.monotonic()
+        with self._lock:
+            ages = [now - st["changed_at"] for st in self._peers.values()
+                    if st["changed_at"] is not None and not st["done"]]
+        return max(ages, default=0.0)
+
+    # ---- monitor thread -------------------------------------------------
+
+    def _tombstone_fresh(self, rec: dict, st: dict) -> bool:
+        return (float(rec.get("t", 0.0)) >= self._t0_wall - 1.0
+                or st["alive"])
+
+    def _scan(self) -> None:
+        now = time.monotonic()
+        for r, st in self._peers.items():
+            if st["done"]:
+                continue
+            rec = heartbeat.read_record(
+                heartbeat.tombstone_path(self.hb_dir, r))
+            if rec is not None and self._tombstone_fresh(rec, st):
+                self._trip(r, "tombstone", st, now, rec)
+                return
+            hb = heartbeat.read_record(
+                heartbeat.heartbeat_path(self.hb_dir, r))
+            if hb is None:
+                continue  # never seen: not judged (module docstring)
+            self._observed_any = True
+            sig = (hb.get("pid"), hb.get("seq"), hb.get("t"))
+            if sig != st["sig"]:
+                st["alive"] = st["alive"] or st["sig"] is not None
+                st["sig"] = sig
+                st["changed_at"] = now
+            if hb.get("phase") == heartbeat.PHASE_DONE:
+                st["done"] = True  # clean departure: never judged
+                continue
+            if now - st["changed_at"] > self.deadline:
+                self._trip(r, "stale", st, now, None)
+                return
+
+    def _trip(self, peer: int, reason: str, st: dict, now: float,
+              tombstone: dict | None) -> None:
+        age = (now - st["changed_at"]) if st["changed_at"] is not None \
+            else 0.0
+        self.verdict = {
+            "peer": int(peer), "reason": reason,
+            "stale_for_s": round(age, 3),
+            "deadline_s": self.deadline,
+            "t_detect": round(time.time(), 3),
+            "tombstone": tombstone,
+        }
+        self.degraded = True
+        self._escalate_at = now + self._escalate_window
+        out = self._out if self._out is not None else sys.stderr
+        ts = ""
+        if tombstone is not None:
+            ts = (f"; tombstone reason={tombstone.get('reason')} "
+                  f"exit_code={tombstone.get('exit_code')} "
+                  f"retryable={tombstone.get('retryable')}")
+        print(f"DEADMAN: peer host {peer} declared dead ({reason}; "
+              f"heartbeat stale {age:.1f}s, deadline "
+              f"{self.deadline:.1f}s{ts}) — pod DEGRADED: refusing new "
+              "collectives, landing the emergency snapshot, exiting "
+              f"retryable (code {exitcodes.PEER_DEAD})",
+              file=out, flush=True)
+        dump_all_stacks(self._out)
+
+    def _watch(self, poll: float) -> None:
+        while not self._stop.wait(poll):
+            if not self.degraded:
+                with self._lock:
+                    try:
+                        self._scan()
+                    except Exception as e:
+                        if not self._scan_warned:
+                            self._scan_warned = True
+                            print("WARNING: deadman scan failed "
+                                  f"({type(e).__name__}: {e}); peer "
+                                  "death detection degraded",
+                                  flush=True)
+                if (self._peers and not self._observed_any
+                        and not self._unobserved_warned
+                        and time.monotonic() - self._t0_mono
+                        > max(3.0 * self.deadline, 30.0)):
+                    # A multi-host pod whose heartbeat dir is NOT on
+                    # shared storage (per-VM local --log-dir) shows
+                    # exactly this signature: peers exist but none is
+                    # ever observable — the deadman would be silently
+                    # inert while the operator believes detection is
+                    # armed. Say so, loudly, once.
+                    self._unobserved_warned = True
+                    out = (self._out if self._out is not None
+                           else sys.stderr)
+                    print("WARNING: deadman has observed NO peer "
+                          "heartbeat since start — is the heartbeat "
+                          f"directory ({self.hb_dir}) on storage "
+                          "shared by all hosts? Until peers are "
+                          "observable, partial-pod failures will NOT "
+                          "be detected out-of-band", file=out,
+                          flush=True)
+                continue
+            with self._lock:
+                escalate = (self._escalate_at is not None
+                            and time.monotonic() > self._escalate_at)
+            if not escalate:
+                continue
+            # The main thread never reached a safe exit: it is wedged
+            # inside a collective the dead peer will never complete.
+            # Same treatment as the watchdog's permanent-hang path.
+            code = self.exit_code_for_verdict()
+            out = self._out if self._out is not None else sys.stderr
+            print("DEADMAN: main thread did not exit within the grace "
+                  f"window ({self._escalate_window:.0f}s) after the "
+                  "peer-death verdict — hard-exiting for requeue "
+                  f"(code {code})", file=out, flush=True)
+            dump_all_stacks(self._out)
+            if self._tombstone_cb is not None:
+                try:
+                    self._tombstone_cb(code)
+                except Exception:
+                    pass
+            try:
+                sys.stderr.flush()
+                sys.stdout.flush()
+            except Exception:
+                pass
+            self._exit(code)
+            return  # only reached when _exit is a test stub
+
+
+class PodHeartbeat:
+    """The engine-facing facade: this host's heartbeat writer + the
+    deadman monitor over its peers + the tombstone channel, with one
+    start/stop lifecycle. Installed as the process-global pod-health
+    gate via ``deadman.activate`` so ``checkpoint.py``'s collective
+    entry points see it without plumbing."""
+
+    def __init__(self, run_dir: str, rank: int, world: int,
+                 deadline_secs: float, interval_secs: float = 2.0,
+                 escalate_secs: float | None = None, out=None,
+                 _exit=os._exit):
+        self.dir = heartbeat.heartbeat_dir(run_dir)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.writer = heartbeat.HeartbeatWriter(self.dir, rank,
+                                                interval_secs)
+        self.monitor = DeadmanMonitor(
+            self.dir, rank, world, deadline_secs,
+            escalate_secs=escalate_secs,
+            tombstone_cb=lambda code: self.tombstone(
+                "peer-dead", code,
+                detail="deadman escalation: main thread wedged"),
+            out=out, _exit=_exit)
+
+    def start(self) -> None:
+        self.writer.start()
+        self.monitor.start()
+
+    def stop(self) -> None:
+        self.monitor.stop()
+        self.writer.stop()
+
+    def note(self, **kw) -> None:
+        self.writer.note(**kw)
+
+    @property
+    def degraded(self) -> bool:
+        return self.monitor.degraded
+
+    @property
+    def verdict(self) -> dict | None:
+        return self.monitor.verdict
+
+    def raise_if_degraded(self, state=None, epoch: int = 0,
+                          resume_step: int = 0) -> None:
+        self.monitor.raise_if_degraded(state=state, epoch=epoch,
+                                       resume_step=resume_step)
+
+    def wait_verdict(self, timeout: float) -> dict | None:
+        return self.monitor.wait_verdict(timeout)
+
+    def max_peer_staleness(self) -> float:
+        return self.monitor.max_peer_staleness()
+
+    def tombstone(self, reason: str, exit_code: int,
+                  detail: str = "") -> bool:
+        return self.writer.tombstone(
+            reason, exit_code, exitcodes.is_retryable(exit_code),
+            detail=detail)

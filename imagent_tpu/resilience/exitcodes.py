@@ -1,0 +1,155 @@
+"""Process exit-code taxonomy: one registry for every deliberate exit.
+
+The launcher's requeue wrapper (``launch/requeue.sh``) decides whether
+to restart a dead task from its exit code alone — the only channel an
+``os._exit`` from a watchdog thread, a deadman escalation, or an
+OOM-killed run leaves behind. Inline ints scattered over the exit
+sites (the old watchdog ``86``) make that contract un-auditable; this
+registry is the single source of truth for *what each code means* and
+*whether a requeue can help* (retryable = the failure is environmental
+— a dead peer, reclaimed VM, flaky storage — and ``--resume`` from the
+last good checkpoint is expected to make progress; non-retryable = the
+run itself is wrong and a restart reproduces the failure).
+
+The numeric choices avoid the shell's reserved ranges (126/127/128+N
+signal exits) and borrow sysexits.h where a meaning matches
+(75 ``EX_TEMPFAIL``, 78 ``EX_CONFIG``). ``launch/requeue.sh`` pins the
+retryable set as a literal (it must work when Python itself cannot
+start); ``tests/test_launch.py`` asserts the two stay in sync.
+
+``FatalRunError`` and its subclasses are how the engine *carries* a
+code: raised out of ``engine.run``, mapped to ``sys.exit`` in
+``__main__`` and to the per-host tombstone record
+(``resilience/heartbeat.py``) a peer's deadman monitor classifies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+OK = 0
+FATAL_EXCEPTION = 70    # EX_SOFTWARE: unhandled exception, unclassified
+PREEMPTED = 75          # EX_TEMPFAIL: clean checkpoint-and-exit (SIGTERM
+                        # preemption notice, or the watchdog's clean path)
+FATAL_CONFIG = 78       # EX_CONFIG: invalid flags/topology — reproduces
+ROLLBACK_GIVE_UP = 79   # non-finite steps persisted through the rollback
+                        # budget — the fault replays deterministically
+WATCHDOG_HARD_EXIT = 86  # watchdog escalation: main thread wedged past
+                         # the grace window (historic code, kept stable)
+PEER_DEAD = 87          # deadman: a pod peer's heartbeat died; the pod
+                        # must requeue together onto --resume
+STORAGE_OUTAGE = 88     # checkpoint storage dead past the retry budget;
+                        # previous generation intact
+
+
+@dataclasses.dataclass(frozen=True)
+class ExitCode:
+    code: int
+    name: str
+    retryable: bool
+    doc: str
+
+
+REGISTRY: tuple[ExitCode, ...] = (
+    ExitCode(OK, "ok", False, "clean finish — nothing to requeue"),
+    ExitCode(FATAL_EXCEPTION, "exception", False,
+             "unhandled exception; diagnose before rerunning"),
+    ExitCode(PREEMPTED, "preempted", True,
+             "clean preemption/watchdog checkpoint-and-exit; "
+             "--resume continues mid-epoch"),
+    ExitCode(FATAL_CONFIG, "fatal-config", False,
+             "invalid flags or run/checkpoint topology mismatch"),
+    ExitCode(ROLLBACK_GIVE_UP, "rollback-give-up", False,
+             "non-finite steps survived every rollback replay "
+             "(data/lr/bf16 problem, not a transient)"),
+    ExitCode(WATCHDOG_HARD_EXIT, "watchdog-hard-exit", True,
+             "no step progress and the main thread never polled the "
+             "stop flag (dead collective)"),
+    ExitCode(PEER_DEAD, "peer-dead", True,
+             "a pod peer stopped heartbeating or left a tombstone; "
+             "requeue the whole pod onto --resume"),
+    ExitCode(STORAGE_OUTAGE, "storage-outage", True,
+             "checkpoint storage unwritable past the bounded retries; "
+             "the previous generation is intact"),
+)
+
+_BY_CODE = {e.code: e for e in REGISTRY}
+_BY_NAME = {e.name: e for e in REGISTRY}
+
+
+def describe(code: int) -> ExitCode | None:
+    """The registry entry for ``code``, or None for unregistered codes
+    (an abrupt kill, a shell 127, an OOM 137...)."""
+    return _BY_CODE.get(int(code))
+
+
+def by_name(name: str) -> ExitCode | None:
+    return _BY_NAME.get(name)
+
+
+def is_retryable(code: int) -> bool:
+    """Whether the launcher should requeue this exit with ``--resume``.
+    Unregistered codes are NOT retryable by default — an unknown
+    failure restarted blindly is a crash loop."""
+    entry = _BY_CODE.get(int(code))
+    return bool(entry and entry.retryable)
+
+
+def retryable_codes() -> tuple[int, ...]:
+    """The codes ``launch/requeue.sh`` must restart on (sorted)."""
+    return tuple(sorted(e.code for e in REGISTRY if e.retryable))
+
+
+class FatalRunError(RuntimeError):
+    """A run-ending failure that carries its exit classification.
+
+    ``engine.run`` raises a subclass; ``__main__`` maps it to the
+    process exit code, and the engine's fatal-exit handling writes the
+    matching tombstone (``reason`` is the tombstone's classification
+    key — a peer's deadman monitor reads it back verbatim)."""
+
+    exit_code: int = FATAL_EXCEPTION
+    reason: str = "exception"
+
+
+class PeerDeathError(FatalRunError):
+    """The deadman declared a pod peer dead (stale heartbeat or fatal
+    tombstone). ``verdict`` is the monitor's detection record;
+    ``salvage`` (optional) is ``{"state", "epoch", "resume_step"}`` —
+    a known-clean state the degraded-exit path can land as process 0's
+    collective-free emergency snapshot.
+
+    ``exit_code`` defaults to the retryable ``PEER_DEAD`` but the
+    raiser may override it: when the peer's tombstone classifies a
+    NON-retryable death (reproducing exception, config error), the
+    survivors must adopt that verdict — requeuing a pod whose member
+    can never rejoin only burns the restart budget on rendezvous
+    timeouts."""
+
+    exit_code = PEER_DEAD
+    reason = "peer-dead"
+
+    def __init__(self, msg: str, verdict: dict | None = None,
+                 salvage: dict | None = None,
+                 exit_code: int | None = None):
+        super().__init__(msg)
+        self.verdict = verdict
+        self.salvage = salvage
+        if exit_code is not None:
+            self.exit_code = int(exit_code)  # instance override
+
+
+class StorageOutageError(FatalRunError):
+    """Checkpoint storage failed past the bounded retry/streak budget;
+    the previous committed generation is untouched."""
+
+    exit_code = STORAGE_OUTAGE
+    reason = "storage-outage"
+
+
+class RollbackGiveUpError(FatalRunError):
+    """The non-finite-step fault reproduced through every rollback
+    replay — a config/data problem a requeue would only repeat."""
+
+    exit_code = ROLLBACK_GIVE_UP
+    reason = "rollback-give-up"

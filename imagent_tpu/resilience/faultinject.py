@@ -44,6 +44,15 @@ Registered fault points (grep for ``faultinject.fire``):
   any rename — the live generation survives untouched and the async
   path pod-agrees the failed verdict at the next landing point instead
   of hanging or splitting the pod.
+* ``host.die`` (engine): abrupt ``os._exit`` mid-epoch — no tombstone,
+  no cleanup, no signal handlers (the VM-reclaim / kernel-panic
+  stand-in). Peers must detect this via heartbeat staleness alone
+  (``resilience/deadman.py``); ``code`` (default 1) sets the exit
+  status, deliberately NOT a registered taxonomy code.
+* ``hb.stale`` (resilience/heartbeat): the heartbeat WRITER freezes
+  while the process keeps running — the unobservable-host drill: peers
+  must (by design) declare this host dead, because a host that cannot
+  prove liveness is indistinguishable from a dead one.
 
 Cost discipline: when nothing is configured, ``fire`` is one falsy
 check on a module dict — safe to call per step / per file in hot

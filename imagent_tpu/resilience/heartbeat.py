@@ -1,0 +1,211 @@
+"""Out-of-band per-host heartbeats + fatal-exit tombstones.
+
+Every pod-level verdict in this codebase — ``checkpoint._pod_agree``,
+the telemetry epoch allgather, the epoch-boundary stop reductions — is
+an *in-band device collective*: it answers "do we agree?" only when
+every participant is alive to answer. One dead host (VM reclaim,
+OOM-kill, kernel panic) turns each of those into a hang, and the
+survivors burn walltime until the per-host watchdog's multi-minute
+hard-exit window expires. This module is the out-of-band channel that
+breaks the symmetry: each host's background thread writes a tiny
+per-host heartbeat record (step frontier, wall clock, pid, last phase)
+to a shared directory under the run dir every few seconds, and writes
+a **tombstone** record on every *deliberate* fatal exit so peers can
+classify the death instantly instead of waiting out a staleness
+deadline. The consumer is ``resilience/deadman.py``.
+
+File contract (all JSON, all written atomically via tmp + rename):
+
+* ``<run_dir>/heartbeats/hb.<rank>.json`` — ``{rank, pid, seq, t,
+  epoch, step, phase}``; ``seq`` strictly increases while the host
+  lives; ``phase == "done"`` is the clean-departure marker (a stopped
+  writer's final beat) that exempts the host from staleness judgment.
+* ``<run_dir>/heartbeats/tombstone.<rank>.json`` — ``{rank, pid,
+  reason, exit_code, retryable, detail, t}``; written at most once per
+  run by the fatal-exit paths (``engine.run``'s handlers, the watchdog
+  and deadman escalations). ``reason`` is the classification key from
+  ``resilience/exitcodes.py``.
+
+Discipline: this module is **jax-free** (asserted by
+``tests/test_pod_failure.py``, same contract as the telemetry
+sampler) — the writer and the monitor must keep functioning precisely
+when every device queue and collective is wedged, and must never add a
+device sync to the step loop. Each host cleans its OWN stale files at
+start (a requeued attempt must not trip peers on last attempt's
+leftovers); monitors additionally ignore tombstones older than their
+own start (see ``deadman.DeadmanMonitor``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from imagent_tpu.resilience import faultinject
+
+HEARTBEAT_DIRNAME = "heartbeats"
+PHASE_DONE = "done"  # clean departure: never judged stale
+
+
+def heartbeat_dir(run_dir: str) -> str:
+    return os.path.join(run_dir, HEARTBEAT_DIRNAME)
+
+
+def heartbeat_path(hb_dir: str, rank: int) -> str:
+    return os.path.join(hb_dir, f"hb.{rank}.json")
+
+
+def tombstone_path(hb_dir: str, rank: int) -> str:
+    return os.path.join(hb_dir, f"tombstone.{rank}.json")
+
+
+def read_record(path: str) -> dict | None:
+    """A heartbeat/tombstone record, or None when absent/torn. Torn
+    reads are expected (the writer renames over the file while the
+    monitor polls) and must never raise."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return None
+
+
+def _write_atomic(path: str, payload: dict) -> None:
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+class HeartbeatWriter:
+    """Background thread writing this host's heartbeat record.
+
+    ``note()`` is the engine-facing surface: a lock-guarded dict update
+    of the step frontier (two ints and a string — the same per-step
+    cost class as the telemetry sampler's timestamp, no I/O, no jax).
+    The file write happens on the writer thread every ``interval_secs``
+    regardless of what the main thread is doing — an out-of-band
+    liveness signal, not a step-loop side effect.
+
+    Fault point ``hb.stale`` (the faultinject registry): once it fires,
+    the writer FREEZES — the thread stays alive and the process keeps
+    training, but no further heartbeat lands. This is the
+    false-positive drill: peers must (by design) declare this host
+    dead, because an unobservable host is indistinguishable from a
+    dead one.
+    """
+
+    def __init__(self, hb_dir: str, rank: int,
+                 interval_secs: float = 2.0):
+        if interval_secs <= 0:
+            raise ValueError("heartbeat interval must be positive")
+        self.hb_dir = hb_dir
+        self.rank = int(rank)
+        self.interval = float(interval_secs)
+        self.path = heartbeat_path(hb_dir, self.rank)
+        self._state = {"epoch": -1, "step": -1, "phase": "init"}
+        self._seq = 0
+        self._frozen = False
+        self._write_errors = 0
+        self._tombstoned = False
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        """Clear THIS rank's stale files from a previous attempt, land
+        the first beat synchronously (peers see us alive before any
+        engine work starts), then start the writer thread."""
+        os.makedirs(self.hb_dir, exist_ok=True)
+        for stale in (self.path, tombstone_path(self.hb_dir, self.rank)):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+        self._write_once()
+        self._thread = threading.Thread(
+            target=self._run, name=f"heartbeat-{self.rank}", daemon=True)
+        self._thread.start()
+
+    def note(self, epoch: int | None = None, step: int | None = None,
+             phase: str | None = None) -> None:
+        """Update the frontier the next beat will carry (cheap: lock +
+        dict stores; no file I/O on the caller's thread)."""
+        with self._lock:
+            if epoch is not None:
+                self._state["epoch"] = int(epoch)
+            if step is not None:
+                self._state["step"] = int(step)
+            if phase is not None:
+                self._state["phase"] = str(phase)
+
+    def _write_once(self) -> None:
+        if self._frozen:
+            return
+        if faultinject.fire("hb.stale") is not None:
+            # The process lives on; only the liveness signal dies.
+            self._frozen = True
+            print("FAULT hb.stale: heartbeat writer frozen (process "
+                  "keeps running)", flush=True)
+            return
+        with self._lock:
+            payload = {"rank": self.rank, "pid": os.getpid(),
+                       "seq": self._seq, "t": time.time(),
+                       **self._state}
+            self._seq += 1
+        try:
+            _write_atomic(self.path, payload)
+        except OSError as e:
+            # Heartbeat storage flaking must not kill the run — but a
+            # host that cannot prove liveness will (correctly) be
+            # declared dead by its peers, so say why, once.
+            self._write_errors += 1
+            if self._write_errors == 1:
+                print(f"WARNING: heartbeat write failed ({e}); peers "
+                      "may declare this host dead if this persists",
+                      flush=True)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self._write_once()
+
+    @property
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def stop(self) -> None:
+        """Stop the thread and land a final ``phase="done"`` beat — the
+        clean-departure marker that tells peer monitors not to judge
+        the ensuing silence as a death."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.note(phase=PHASE_DONE)
+        self._write_once()
+
+    def tombstone(self, reason: str, exit_code: int, retryable: bool,
+                  detail: str = "") -> bool:
+        """Write this host's fatal-exit classification (at most once —
+        the first cause wins; later handlers on the same unwind are
+        echoes). Returns True if this call wrote it."""
+        if self._tombstoned:
+            return False
+        self._tombstoned = True
+        payload = {"rank": self.rank, "pid": os.getpid(),
+                   "reason": str(reason), "exit_code": int(exit_code),
+                   "retryable": bool(retryable),
+                   "detail": str(detail)[:500], "t": time.time()}
+        try:
+            os.makedirs(self.hb_dir, exist_ok=True)
+            _write_atomic(tombstone_path(self.hb_dir, self.rank),
+                          payload)
+        except OSError as e:
+            print(f"WARNING: could not write tombstone ({e}); peers "
+                  "will detect this exit via heartbeat staleness "
+                  "instead", flush=True)
+            return False
+        return True

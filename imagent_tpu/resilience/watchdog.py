@@ -28,6 +28,8 @@ import threading
 import time
 import traceback
 
+from imagent_tpu.resilience import exitcodes
+
 
 def dump_all_stacks(out=None) -> None:
     """Write every live thread's Python stack to ``out`` (default: the
@@ -66,13 +68,17 @@ class StepWatchdog:
     path takes over).
     """
 
-    ESCALATE_EXIT_CODE = 86
+    ESCALATE_EXIT_CODE = exitcodes.WATCHDOG_HARD_EXIT
 
     def __init__(self, deadline_secs: float, out=None):
         if deadline_secs <= 0:
             raise ValueError("watchdog deadline must be positive")
         self.deadline = float(deadline_secs)
         self.fired = False
+        # Optional pre-hard-exit hook (engine wires the heartbeat
+        # tombstone here so peers classify the 86 instantly instead of
+        # waiting out the staleness deadline).
+        self.on_escalate = None
         self._out = out
         self._armed = False
         self._deadline_at: float | None = None  # None = not counting
@@ -184,6 +190,12 @@ class StepWatchdog:
                       "window — hard-exiting for scheduler requeue "
                       f"(code {self.ESCALATE_EXIT_CODE})",
                       file=out, flush=True)
+                cb = self.on_escalate
+                if cb is not None:
+                    try:
+                        cb()
+                    except Exception:
+                        pass
                 try:
                     sys.stderr.flush()
                     sys.stdout.flush()

@@ -122,6 +122,27 @@ class TelemetrySession:
         if self.enabled and self._in_epoch:
             self.counters[name] = self.counters.get(name, 0) + inc
 
+    def gauge(self, name: str, value: float) -> None:
+        """A per-epoch high-water gauge (kept as max, not summed) —
+        e.g. the peak peer-heartbeat staleness the deadman observed,
+        which creeping toward --peer-deadline-secs IS the early
+        warning for a host about to be declared dead."""
+        if self.enabled and self._in_epoch:
+            self.counters[name] = max(
+                float(self.counters.get(name, 0.0)), float(value))
+
+    def pod_degraded(self, info: dict) -> None:
+        """The deadman's detection verdict: a peer died and this run is
+        exiting retryable. Written as a ``pod_degraded`` event (the
+        post-mortem record: who died, how it was detected, how stale
+        the heartbeat was vs the deadline) plus a TB marker scalar.
+        Out-of-band by construction — called from the degraded exit
+        ramp, where no collective may run; pure local file writes."""
+        if self.writer is not None:
+            self.writer.write("pod_degraded", info)
+        if self.logger is not None:
+            self.logger.pod_degraded(int(info.get("epoch", 0)))
+
     # ---- per-step surface (host arithmetic only — no jax) ---------------
 
     def record_dispatch(self, seconds: float) -> None:

@@ -113,7 +113,23 @@ class TrainLogger:
         if "peak_bytes_in_use" in hbm:
             w.add_scalar("hbm/peak_mb",
                          hbm["peak_bytes_in_use"] / 1e6, epoch)
+        counters = record.get("counters") or {}
+        if "hb_peer_staleness_s" in counters:
+            # Peak peer-heartbeat age the deadman saw this epoch:
+            # trending toward --peer-deadline-secs = a host about to be
+            # declared dead (or a deadline tuned too tight).
+            w.add_scalar("pod/hb_peer_staleness_s",
+                         counters["hb_peer_staleness_s"], epoch)
         w.flush()
+
+    def pod_degraded(self, epoch: int) -> None:
+        """Marker series for the deadman verdict: the run lost a peer
+        at this epoch and exited retryable (the detection detail lives
+        in telemetry.jsonl's ``pod_degraded`` event)."""
+        if self.writer is None:
+            return
+        self.writer.add_scalar("pod/degraded", 1.0, epoch)
+        self.writer.flush()
 
     def final_summary(self, best_epoch: int, best_top1: float,
                       best_top5: float, total_minutes: float) -> None:

@@ -1,0 +1,131 @@
+"""Partial-pod-failure acceptance drill worker (2 OS processes), two
+phases via ``IMAGENT_DEADMAN_PHASE``:
+
+``kill``: both ranks form a real 2-process mesh and train with the
+heartbeat deadman armed (deadline 2s, beat 0.25s) and a 60s watchdog
+(so the drill proves the DEADMAN wins the race, not the watchdog's
+multi-minute path). At step 3 of epoch 0, rank 1 hard-dies via the
+``host.die`` fault (abrupt ``os._exit``, NO tombstone — the VM-reclaim
+stand-in) while rank 0's ``stall-step`` fault holds it OUT of the next
+collective for 5s. Rank 0's monitor must declare peer 1 dead via
+heartbeat staleness within the deadline, the loop's pre-dispatch check
+must divert it before it files into another psum, process 0 must land
+the collective-free flat emergency snapshot as LAST (epoch -1,
+resume_step 3 — the three pairwise-retired steps), write a
+``peer-dead`` tombstone, log a ``pod_degraded`` telemetry event, and
+exit with the retryable peer-death code (87). The fault specs arrive
+via IMAGENT_FAULTS (per-rank env), regression-testing the env export
+path the spawned-worker arming depends on.
+
+``resume``: a fresh 2-process pod restores with ``--resume`` — the
+emergency snapshot must come back as ``last`` (epoch 0, step 3), the
+remaining 5 + 8 steps train to completion, and both ranks exit 0.
+
+Usage: python mp_worker_deadman.py <rank> <port> <world>  (scratch dir
+via IMAGENT_MP_SCRATCH).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    scratch = os.environ["IMAGENT_MP_SCRATCH"]
+    phase = os.environ.get("IMAGENT_DEADMAN_PHASE", "kill")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2")
+    os.environ.update({
+        "SLURM_JOB_NUM_NODES": "2",
+        "SLURM_NODEID": str(rank),
+        "SLURM_LOCALID": "0",
+        "SLURM_PROCID": str(rank),
+        "SLURM_NTASKS": "2",
+        "SLURM_JOB_NODELIST": "127.0.0.1",
+        "IMAGENT_COORDINATOR_PORT": str(port),
+    })
+    if phase == "kill":
+        # Rank-specific faults through the ENV channel (what a real
+        # operator drill on a live pod uses; cfg.faults stays empty so
+        # engine.run's configure(None) picks these up).
+        if rank == 0:
+            os.environ["IMAGENT_FAULTS"] = "stall-step:after=3;secs=5"
+        else:
+            os.environ["IMAGENT_FAULTS"] = "host.die:after=3"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from imagent_tpu.config import Config
+    from imagent_tpu.engine import run
+    from imagent_tpu.resilience import exitcodes
+
+    # 2 procs x 2 fake devices -> global batch 16; 128 imgs -> 8
+    # steps/epoch; the faults above target step 3 (mid-epoch 0).
+    cfg = Config(arch="resnet18", image_size=16, num_classes=4,
+                 batch_size=4, epochs=2, lr=0.05, dataset="synthetic",
+                 synthetic_size=128, workers=0, bf16=False, log_every=0,
+                 seed=0, save_model=True, keep_last_k=1, backend="cpu",
+                 eval_every=2, watchdog_secs=60.0,
+                 peer_deadline_secs=2.0, heartbeat_secs=0.25,
+                 resume=(phase == "resume"),
+                 log_dir=os.path.join(scratch, "tb"),
+                 ckpt_dir=os.path.join(scratch, "ck"))
+
+    if phase == "kill":
+        t0 = time.time()
+        try:
+            run(cfg)
+        except exitcodes.PeerDeathError as e:
+            v = e.verdict or {}
+            # The survivor (process 0) verifies the emergency snapshot
+            # landed in the collective-free flat format with the
+            # mid-epoch meta --resume needs.
+            snap = os.path.join(scratch, "ck", "last", "snapshot.json")
+            assert os.path.isfile(snap), "no emergency snapshot"
+            with open(snap) as f:
+                meta = json.load(f)["meta"]
+            assert meta["epoch"] == -1 and meta["resume_step"] == 3, meta
+            assert not os.path.exists(os.path.join(
+                scratch, "ck", "last.pending.json"))
+            ts = os.path.join(scratch, "tb", "heartbeats",
+                              "tombstone.0.json")
+            with open(ts) as f:
+                stone = json.load(f)
+            assert stone["reason"] == "peer-dead" and stone["retryable"]
+            # No tombstone for the abruptly-dead rank 1 (host.die).
+            assert not os.path.exists(os.path.join(
+                scratch, "tb", "heartbeats", "tombstone.1.json"))
+            events = [json.loads(ln) for ln in open(os.path.join(
+                scratch, "tb", "telemetry.jsonl"))]
+            degraded = [ev for ev in events
+                        if ev.get("event") == "pod_degraded"]
+            assert degraded and degraded[0]["peer"] == 1, events
+            print(f"DEADMAN_OK peer={v.get('peer')} "
+                  f"reason={v.get('reason')} "
+                  f"detect_s={v.get('stale_for_s'):.2f} "
+                  f"wall_s={time.time() - t0:.2f}", flush=True)
+            sys.stdout.flush()
+            # Same contract as __main__: a normal exit would run the
+            # JAX distributed shutdown barrier against the dead peer
+            # and SIGABRT, destroying the retryable exit code.
+            os._exit(e.exit_code)
+        print("DRILL_FAIL: run returned normally", flush=True)
+        return 1
+
+    # phase == "resume": the requeued pod restores the emergency
+    # snapshot and completes the run.
+    result = run(cfg)
+    assert result["preempted"] is False, result
+    assert result["best_epoch"] >= 0, result
+    print(f"RESUME_OK rank={rank} best_epoch={result['best_epoch']}",
+          flush=True)
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,7 @@ stub that records the fan-out command.
 """
 
 import os
+import re
 import stat
 import subprocess
 
@@ -116,7 +117,8 @@ def test_slurm_launcher_runs_two_rank_training(tmp_path):
 
 
 def test_tpu_pod_launcher_fans_out(tmp_path):
-    """tpu_pod.sh composes the worker=all fan-out command."""
+    """tpu_pod.sh composes the worker=all fan-out command (now routed
+    through the requeue wrapper with the deadman armed)."""
     bindir = tmp_path / "bin"
     bindir.mkdir()
     _write_stub(str(bindir / "gcloud"), _GCLOUD_STUB)
@@ -135,5 +137,84 @@ def test_tpu_pod_launcher_fans_out(tmp_path):
     assert args[:5] == ["compute", "tpus", "tpu-vm", "ssh", "my-pod"]
     assert "--worker=all" in args
     cmd = args[args.index("--command") + 1]
+    assert "bash imagent_tpu/launch/requeue.sh" in cmd
     assert "python -m imagent_tpu --backend=tpu" in cmd
+    assert "--peer-deadline-secs=60" in cmd
     assert "--arch=resnet50 --batch-size=128" in cmd
+
+
+# ---------------------------------------------------------------------------
+# launch/requeue.sh — the auto-requeue wrapper
+# ---------------------------------------------------------------------------
+
+_REQUEUE = os.path.join(_LAUNCH, "requeue.sh")
+
+# A stub "trainer" scripted by a file of per-attempt exit codes: each
+# invocation pops the next code, and records its argv — so the tests
+# can assert both the restart count and the --resume contract.
+_TRAINER_STUB = """#!/bin/bash
+echo "$@" >> "${CALLS_FILE}"
+code=$(head -n 1 "${CODES_FILE}")
+sed -i 1d "${CODES_FILE}"
+exit "${code:-0}"
+"""
+
+
+def _run_requeue(tmp_path, codes, budget=3):
+    calls = tmp_path / "calls.txt"
+    codes_file = tmp_path / "codes.txt"
+    calls.write_text("")
+    codes_file.write_text("\n".join(str(c) for c in codes) + "\n")
+    trainer = tmp_path / "trainer.sh"
+    _write_stub(str(trainer), _TRAINER_STUB)
+    env = dict(os.environ)
+    env.update({"CALLS_FILE": str(calls), "CODES_FILE": str(codes_file),
+                "IMAGENT_RESTART_BUDGET": str(budget),
+                "IMAGENT_RESTART_BACKOFF": "0"})
+    proc = subprocess.run(
+        ["bash", _REQUEUE, "bash", str(trainer), "--epochs=2"],
+        env=env, capture_output=True, text=True, timeout=60)
+    attempts = [ln for ln in calls.read_text().splitlines() if ln]
+    return proc, attempts
+
+
+def test_requeue_restarts_retryable_exit_with_resume(tmp_path):
+    """Peer-death (87) restarts the command with --resume appended;
+    the eventual clean exit ends the loop with 0."""
+    proc, attempts = _run_requeue(tmp_path, [87, 75, 0])
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert len(attempts) == 3
+    assert "--resume" not in attempts[0]
+    assert attempts[1].endswith("--resume")
+    assert attempts[2].endswith("--resume")
+    assert "retryable exit 87" in proc.stderr
+
+
+def test_requeue_gives_up_on_fatal_code(tmp_path):
+    """A config error (78) must NOT crash-loop: one attempt, original
+    code propagated."""
+    proc, attempts = _run_requeue(tmp_path, [78, 0])
+    assert proc.returncode == 78
+    assert len(attempts) == 1
+    assert "not retryable" in proc.stderr
+
+
+def test_requeue_budget_bounds_the_restarts(tmp_path):
+    proc, attempts = _run_requeue(tmp_path, [87, 87, 87, 87, 87],
+                                  budget=2)
+    assert proc.returncode == 87
+    assert len(attempts) == 3  # first run + 2 restarts
+    assert "restart budget (2) exhausted" in proc.stderr
+
+
+def test_requeue_retryable_set_matches_exitcode_registry():
+    """The wrapper pins the retryable set as a shell literal (it must
+    work when Python cannot start); this test is the sync contract
+    with resilience/exitcodes.py."""
+    from imagent_tpu.resilience import exitcodes
+    with open(_REQUEUE) as f:
+        src = f.read()
+    m = re.search(r'IMAGENT_RETRYABLE_CODES:-([0-9 ]+)}', src)
+    assert m, "requeue.sh lost its retryable-code default"
+    shell_codes = tuple(sorted(int(c) for c in m.group(1).split()))
+    assert shell_codes == exitcodes.retryable_codes()
