@@ -4,7 +4,8 @@
 PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
 
-.PHONY: smoke test lint bench-smoke bench-anatomy drill-pod
+.PHONY: smoke test lint bench-smoke bench-anatomy drill-pod \
+	drill-divergence
 
 # Static-analysis gate (docs/STATIC_ANALYSIS.md): jaxlint — the
 # JAX/TPU-aware rules in imagent_tpu/analysis — over the package, the
@@ -43,6 +44,16 @@ test:
 drill-pod:
 	$(PYTEST) -m "not slow" tests/test_pod_failure.py \
 	    tests/test_launch.py
+
+# Divergence drill (docs/OPERATIONS.md "Reading model health"): the
+# step.grad_spike fault blows the update scale while every step stays
+# FINITE; the health early-warning detector must catch it and
+# --health-rollback must restore the last good checkpoint BEFORE the
+# non-finite guard ever fires — plus the health unit/engine suite
+# (EWMA detector, flight recorder, status surface). All tier-1.
+drill-divergence:
+	$(PYTEST) -m "not slow" tests/test_health.py
+	$(PYTEST) -m "not slow" tests/test_fault_drills.py -k divergence
 
 # Tiny synthetic-data bench iteration through the real input path
 # (uint8 wire -> device_prefetch -> in-graph normalize -> step) on the

@@ -71,6 +71,16 @@ _META_FIELDS = (
     ("global_batch", np.int64, 0),
     ("process_count", np.int64, 0),
     ("seed", np.int64, -1),
+    # Health-EWMA snapshot at save time (telemetry/health.py): a
+    # --resume re-seeds the divergence detector from these instead of
+    # cold-starting its baseline — a resume directly into a spike must
+    # be judged against the PRE-crash baseline, not an empty one.
+    # Appended last: older checkpoints restore with the defaults
+    # (health_ewma_n == 0 ⇒ the detector warms up fresh).
+    ("health_loss_ewma", np.float64, 0.0),
+    ("health_grad_ewma", np.float64, 0.0),
+    ("health_ratio_ewma", np.float64, 0.0),
+    ("health_ewma_n", np.int64, 0),
 )
 
 _ckptr: ocp.StandardCheckpointer | None = None

@@ -139,6 +139,34 @@ class Config:
     # against.
     async_ckpt: bool = True
 
+    # ---- model-health observability (telemetry/health.py) ----
+    # In-graph health stats: the train step appends global grad-norm,
+    # param-norm and update-ratio to the replicated metric vector
+    # (train.HEALTH_FIELDS), consumed on the lagged frontier — zero
+    # added host syncs. --no-health-stats is the kill switch.
+    health_stats: bool = True
+    # Divergence early-warning: an observation exceeding this factor x
+    # its trailing EWMA baseline (grad-norm and update-ratio) is a
+    # health anomaly — warned, logged as a health_anomaly telemetry
+    # event, and (with --health-rollback) fed to the rollback
+    # machinery BEFORE the non-finite guard can fire. 0 disables.
+    health_grad_spike: float = 10.0
+    # Same, for the per-step train loss. Deliberately loose: 3-4x loss
+    # excursions are routine in early training (measured on the CPU
+    # drill geometry); a 10x spike over the trailing EWMA is a
+    # genuinely diverging run, not noise.
+    health_loss_spike: float = 10.0
+    # Clean steps the EWMA baselines must absorb before any verdict.
+    health_warmup_steps: int = 20
+    # Roll back to the last good checkpoint on a health anomaly (off =
+    # warn + telemetry only).
+    health_rollback: bool = False
+    # Crash flight recorder (telemetry/flightrec.py): ring of the last
+    # N lagged step/health records, flushed as
+    # <log_dir>/flightrec.<rank>.json on every fatal exit path and
+    # referenced from the tombstone. 0 disables.
+    flightrec_steps: int = 256
+
     # ---- resilience (imagent_tpu/resilience/) ----
     # Non-finite step guard: bad steps are always skipped in-graph
     # (train.py); after this many CONSECUTIVE skipped steps the engine
@@ -363,6 +391,38 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fully synchronous checkpoint saves (the "
                         "step loop stalls for serialize+commit+"
                         "manifest)")
+    # Model-health observability.
+    p.add_argument("--no-health-stats", dest="health_stats",
+                   action="store_false", default=True,
+                   help="disable the in-graph grad/param-norm + "
+                        "update-ratio metric tail and the divergence "
+                        "early-warning detector")
+    p.add_argument("--health-grad-spike", type=float,
+                   default=c.health_grad_spike,
+                   help="anomaly when grad-norm or update-ratio "
+                        "exceeds this factor x its trailing EWMA "
+                        "baseline (0 disables)")
+    p.add_argument("--health-loss-spike", type=float,
+                   default=c.health_loss_spike,
+                   help="anomaly when the train loss exceeds this "
+                        "factor x its EWMA baseline (loose by design: "
+                        "3-4x excursions are normal early training; "
+                        "0 disables)")
+    p.add_argument("--health-warmup-steps", type=int,
+                   default=c.health_warmup_steps,
+                   help="clean steps the health baselines absorb "
+                        "before any anomaly verdict")
+    p.add_argument("--health-rollback", action="store_true",
+                   default=False,
+                   help="roll back to the last good checkpoint on a "
+                        "health anomaly (divergence caught BEFORE the "
+                        "non-finite guard; default: warn only)")
+    p.add_argument("--flightrec-steps", type=int,
+                   default=c.flightrec_steps,
+                   help="flight-recorder ring size: last N lagged "
+                        "step/health records flushed as "
+                        "flightrec.<rank>.json on fatal exits "
+                        "(0 disables)")
     # Resilience subsystem.
     p.add_argument("--max-bad-steps", type=int, default=c.max_bad_steps,
                    help="consecutive non-finite (skipped) steps before "

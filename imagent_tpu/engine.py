@@ -44,7 +44,10 @@ from imagent_tpu.resilience import exitcodes, faultinject
 from imagent_tpu.resilience.deadman import PodHeartbeat
 from imagent_tpu.resilience.watchdog import StepWatchdog
 from imagent_tpu.schedule import lr_for_epoch
+from imagent_tpu.status import StatusWriter
 from imagent_tpu.telemetry import TelemetrySession, parse_profile_at_step
+from imagent_tpu.telemetry import flightrec as flightrec_lib
+from imagent_tpu.telemetry.health import HealthMonitor
 from imagent_tpu.train import (
     TrainState, create_train_state, make_eval_step, make_optimizer,
     make_train_step, place_state, state_partition_specs,
@@ -128,11 +131,23 @@ class _LaggedMetrics:
     """
 
     def __init__(self, lag: int = _GUARD_LAG, max_bad: int = 0,
-                 is_master: bool = False):
+                 is_master: bool = False,
+                 health: HealthMonitor | None = None,
+                 health_rollback: bool = False, epoch: int = 0,
+                 start_step: int = 0):
         self._pending: collections.deque = collections.deque()
         self.lag = lag
         self.max_bad = max_bad
         self.is_master = is_master
+        # Model-health tail: vectors longer than the classic 4-field
+        # head carry train.HEALTH_FIELDS; each consumed vector is
+        # handed to the monitor (host arithmetic + a ring store — the
+        # same cost class as the guard check itself).
+        self.health = health
+        self.health_rollback = health_rollback
+        self.health_tripped = False
+        self._epoch = epoch
+        self._step0 = start_step
         self._sums = np.zeros(4, np.float64)
         self.steps = 0
         self.bad_steps = 0
@@ -142,10 +157,11 @@ class _LaggedMetrics:
 
     def _consume(self, m) -> None:
         v = np.asarray(m)
-        self._sums += v
+        self._sums += v[:4]
         self.steps += 1
         self.last = v
-        if v[3] == 0:  # n == 0: the in-graph guard skipped this update
+        bad = v[3] == 0  # n == 0: the in-graph guard skipped this step
+        if bad:
             self.bad_steps += 1
             self.consec_bad += 1
             if self.is_master and self.max_bad:
@@ -159,6 +175,20 @@ class _LaggedMetrics:
                 self.tripped = True
         else:
             self.consec_bad = 0
+        if self.health is not None and v.shape[0] > 4:
+            anomaly = self.health.observe(
+                epoch=self._epoch,
+                step=self._step0 + self.steps - 1,
+                loss=float(v[0]) / max(float(v[3]), 1.0),
+                grad_norm=float(v[4]), param_norm=float(v[5]),
+                update_ratio=float(v[6]), bad=bool(bad),
+                t=time.time())
+            if anomaly is not None and self.health_rollback:
+                # Divergence early-warning: same pod-agreed trip
+                # semantics as the guard (the verdict rides the
+                # REPLICATED vector every host consumes in order), so
+                # the existing rollback machinery applies unchanged.
+                self.health_tripped = True
 
     def push(self, m) -> None:
         """Record a just-dispatched step's metric vector; consumes the
@@ -228,6 +258,8 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                     telem: TelemetrySession | None = None,
                     prefetch: Prefetcher | None = None,
                     pod: PodHeartbeat | None = None,
+                    health: HealthMonitor | None = None,
+                    status: StatusWriter | None = None,
                     ) -> tuple[TrainState, dict, float, int, bool,
                                Prefetcher | None]:
     """One training epoch (reference ``train()``, ``imagenet.py:97-151``).
@@ -286,8 +318,16 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
         np.asarray(lr, np.float32))
     interrupted_at = -1
     steps_done = start_step
+    # ``health`` (telemetry/health.py): every consumed lagged vector's
+    # HEALTH_FIELDS tail feeds the divergence detector; an anomaly with
+    # --health-rollback armed trips the SAME rollback flag as the
+    # non-finite guard — caught while the steps are still finite.
+    # ``status``: the master's live status.json surface, rewritten at
+    # each --log-every boundary (one atomic local write, no syncs).
     acc = _LaggedMetrics(max_bad=max(cfg.max_bad_steps, 0),
-                         is_master=is_master)
+                         is_master=is_master, health=health,
+                         health_rollback=cfg.health_rollback,
+                         epoch=epoch, start_step=start_step)
     rollback = False
 
     if prefetch is not None:
@@ -320,7 +360,22 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                 break
             data_time.update(time.time() - t_fetch)
             images, labels = arrays
+            lr_step = lr_arr
             if faultinject.active():  # drills only; falsy no-op otherwise
+                f = faultinject.fire("step.grad_spike")
+                if f is not None:
+                    # Divergence drill: scale THIS dispatch's lr — the
+                    # update ratio spikes on the spiked step itself and
+                    # the blown-up params spike the following steps'
+                    # loss/grad norms, all still FINITE: exactly the
+                    # ramp the early-warning detector must catch before
+                    # the non-finite guard sees anything. The eager
+                    # multiply preserves the replicated sharding and
+                    # dispatches async (no host sync).
+                    factor = float(f.get("factor", 64.0))
+                    print(f"FAULT step.grad_spike: lr x{factor:g} for "
+                          "this step", flush=True)
+                    lr_step = lr_arr * jnp.float32(factor)
                 f = faultinject.fire("stall-step")
                 if f is not None:  # hung collective / wedged input stand-in
                     time.sleep(float(f.get("secs", 5.0)))
@@ -352,7 +407,7 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                 telem.profile_step(
                     epoch * loader.steps_per_epoch + step_i)
                 t_dispatch = time.perf_counter()
-            state, metrics = train_step(state, images, labels, lr_arr)
+            state, metrics = train_step(state, images, labels, lr_step)
             if telem is not None:
                 # Dispatch is async: this duration is µs on a steady
                 # step and seconds on a compiling one — the accountant
@@ -365,7 +420,7 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
             # (blocking-call-in-step-loop lint invariant).
             acc.push(metrics)
             steps_done += 1
-            if acc.tripped:
+            if acc.tripped or acc.health_tripped:
                 rollback = True
                 break
             if watchdog is not None:
@@ -381,6 +436,22 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                       f"{m[0] / max(m[3], 1):.4f} "
                       f"data_time {data_time.avg:.3f}s",
                       flush=True)
+                if status is not None:
+                    # The live frontier for `python -m
+                    # imagent_tpu.status`: one small atomic local
+                    # write per log interval — same cost class as the
+                    # print above, nothing device-side.
+                    status.write({
+                        "phase": "train", "epoch": epoch,
+                        "epochs": cfg.epochs, "step": step_i + 1,
+                        "steps_per_epoch": loader.steps_per_epoch,
+                        "loss": float(m[0]) / max(float(m[3]), 1.0),
+                        "lr": lr, "bad_steps": acc.bad_steps,
+                        "degraded": bool(pod is not None
+                                         and pod.degraded),
+                        "health": (health.snapshot()
+                                   if health is not None else None),
+                    })
             t_fetch = time.time()
     finally:
         if watchdog is not None:
@@ -398,14 +469,19 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                           depth=cfg.prefetch_depth)
     t_drain = time.perf_counter()
     # Drain the ≤ _GUARD_LAG-step in-flight tail (not a sync). A trip
-    # discovered here counts only for a completed epoch — a preemption
-    # exit keeps the interrupted-checkpoint path.
-    if acc.drain() and interrupted_at < 0:
+    # discovered here — the guard's or the health detector's — counts
+    # only for a completed epoch; a preemption exit keeps the
+    # interrupted-checkpoint path.
+    if (acc.drain() or acc.health_tripped) and interrupted_at < 0:
         rollback = True
         if warm is not None:
             warm.close()
             warm = None
     epoch_metrics = acc.summary()
+    # Which tripwire asked for the rollback: the caller's no-checkpoint
+    # fallback must NOT claim "state unpoisoned" for a health trip —
+    # the diverging updates, unlike guard-skipped ones, WERE applied.
+    epoch_metrics["health_rollback"] = bool(acc.health_tripped)
     if telem is not None:
         # The drain wait is the device retiring the dispatched frontier
         # tail — the device-side tail of useful training work.
@@ -591,6 +667,15 @@ def run(cfg: Config, stop_check=None) -> dict:
     Fault drills: ``--faults`` / ``IMAGENT_FAULTS`` arm named fault
     points (resilience/faultinject.py).
 
+    Model health (``--health-stats``, on by default): the train step's
+    metric vector carries grad/param-norm and update-ratio scalars
+    consumed on the lagged frontier; an EWMA divergence detector warns
+    (and with ``--health-rollback`` rolls back) BEFORE the non-finite
+    guard can fire, a flight recorder of the last N step records is
+    flushed on every fatal exit path, and process 0 keeps
+    ``status.json`` live for ``python -m imagent_tpu.status``
+    (docs/OPERATIONS.md "Reading model health").
+
     With ``--peer-deadline-secs`` the out-of-band heartbeat mesh runs
     for the whole call (resilience/heartbeat + deadman): this host
     beats into ``<log_dir>/heartbeats/`` and watches its peers with no
@@ -609,6 +694,8 @@ def run(cfg: Config, stop_check=None) -> dict:
               flush=True)
     if cfg.peer_deadline_secs < 0:
         raise ValueError("--peer-deadline-secs must be >= 0 (0 = off)")
+    if cfg.flightrec_steps < 0:
+        raise ValueError("--flightrec-steps must be >= 0 (0 = off)")
     pod = None
     if cfg.peer_deadline_secs > 0:
         if cfg.heartbeat_secs <= 0:
@@ -626,6 +713,22 @@ def run(cfg: Config, stop_check=None) -> dict:
                            interval_secs=cfg.heartbeat_secs)
         pod.start()
         deadman_lib.activate(pod)
+    recorder = None
+    if cfg.flightrec_steps > 0 and cfg.health_stats:
+        # Crash flight recorder (telemetry/flightrec.py): the last N
+        # lagged health records, landed as flightrec.<rank>.json by
+        # every fatal exit ramp below — including the watchdog's and
+        # deadman's hard-exit threads, which reach it through the
+        # module-global active handle / the pod's tombstone hook.
+        recorder = flightrec_lib.FlightRecorder(
+            cfg.log_dir, jax.process_index(),
+            capacity=cfg.flightrec_steps)
+        flightrec_lib.activate(recorder)
+    if pod is not None:
+        # Every tombstone write (all deliberate fatal ramps funnel
+        # there, including the monitor threads' os._exit paths) first
+        # flushes the flight recorder and references it in the detail.
+        pod.on_fatal = flightrec_lib.flush_active
     guard = None
     if stop_check is None:
         stop_check = guard = PreemptionGuard()
@@ -634,33 +737,52 @@ def run(cfg: Config, stop_check=None) -> dict:
         watchdog = StepWatchdog(cfg.watchdog_secs)
         base_stop = stop_check
         stop_check = lambda: watchdog.fired or base_stop()  # noqa: E731
-        if pod is not None:
-            # The watchdog's hard-exit leaves a classified tombstone so
-            # peers fail over instantly instead of waiting out the
-            # staleness deadline (shared escalation machinery).
-            watchdog.on_escalate = lambda: pod.tombstone(
-                "watchdog-hard-exit", exitcodes.WATCHDOG_HARD_EXIT,
-                detail="no step progress; main thread never polled")
+
+        def _on_watchdog_escalate():
+            # Hard-exit ramp: land the forensic record, then (with the
+            # mesh armed) the classified tombstone so peers fail over
+            # instantly instead of waiting out the staleness deadline.
+            detail = "no step progress; main thread never polled"
+            if pod is not None:
+                pod.tombstone("watchdog-hard-exit",
+                              exitcodes.WATCHDOG_HARD_EXIT,
+                              detail=detail)  # flushes via on_fatal
+            else:
+                flightrec_lib.flush_active(
+                    "watchdog-hard-exit",
+                    exitcodes.WATCHDOG_HARD_EXIT, detail=detail)
+
+        watchdog.on_escalate = _on_watchdog_escalate
     try:
-        return _run(cfg, stop_check, senv, watchdog, pod)
+        return _run(cfg, stop_check, senv, watchdog, pod, recorder)
     except exitcodes.FatalRunError as e:
         # Classified fatal exits (peer death, storage outage, rollback
-        # give-up): the tombstone may already exist from the exit ramp;
-        # the writer's write-once guard keeps the first cause.
+        # give-up): flight recorder first (write-once — an exit ramp
+        # may have flushed already), then the tombstone; its writer's
+        # write-once guard keeps the first cause.
+        flightrec_lib.flush_active(e.reason, e.exit_code,
+                                   detail=str(e))
         if pod is not None:
             pod.tombstone(e.reason, e.exit_code, detail=str(e))
         raise
     except ValueError as e:
+        flightrec_lib.flush_active("fatal-config",
+                                   exitcodes.FATAL_CONFIG,
+                                   detail=str(e))
         if pod is not None:
             pod.tombstone("fatal-config", exitcodes.FATAL_CONFIG,
                           detail=str(e))
         raise
     except Exception as e:
+        flightrec_lib.flush_active(
+            "exception", exitcodes.FATAL_EXCEPTION,
+            detail=f"{type(e).__name__}: {e}")
         if pod is not None:
             pod.tombstone("exception", exitcodes.FATAL_EXCEPTION,
                           detail=f"{type(e).__name__}: {e}")
         raise
     finally:
+        flightrec_lib.deactivate()
         if pod is not None:
             deadman_lib.deactivate()
             pod.stop()
@@ -724,7 +846,9 @@ def _pod_death_exit(cfg: Config, err, pod, telem, epoch: int,
     telem.pod_degraded(v)
     salvage = err.salvage
     if salvage is not None and jax.process_index() == 0:
-        meta = {**best_meta, **topo_meta,
+        health_meta = (telem.health.meta_snapshot()
+                       if telem.health is not None else {})
+        meta = {**best_meta, **topo_meta, **health_meta,
                 "epoch": int(salvage["epoch"]),
                 "resume_step": int(salvage["resume_step"])}
         try:
@@ -743,7 +867,8 @@ def _pod_death_exit(cfg: Config, err, pod, telem, epoch: int,
         pod.tombstone(err.reason, err.exit_code, detail=str(err))
 
 
-def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
+def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
+         recorder=None) -> dict:
     if cfg.compile_cache:
         jax.config.update("jax_compilation_cache_dir",
                           os.path.abspath(cfg.compile_cache))
@@ -781,6 +906,30 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
     if cfg.straggler_factor < 0:
         raise ValueError("--straggler-factor must be >= 0 "
                          "(0 disables flagging)")
+    if cfg.health_warmup_steps < 1:
+        raise ValueError("--health-warmup-steps must be >= 1")
+    if cfg.health_grad_spike < 0 or cfg.health_loss_spike < 0:
+        raise ValueError("--health-grad-spike / --health-loss-spike "
+                         "must be >= 0 (0 disables that check)")
+    if cfg.health_rollback and not cfg.health_stats:
+        raise ValueError("--health-rollback needs the in-graph health "
+                         "stats (drop --no-health-stats)")
+    # Divergence early-warning (telemetry/health.py): consumes the
+    # HEALTH_FIELDS tail of every lagged metric vector. Created before
+    # any restore so --resume can re-seed its EWMA baselines from the
+    # checkpoint meta instead of cold-starting them.
+    monitor = None
+    if cfg.health_stats:
+        monitor = HealthMonitor(
+            grad_spike_factor=cfg.health_grad_spike,
+            loss_spike_factor=cfg.health_loss_spike,
+            warmup_steps=cfg.health_warmup_steps,
+            recorder=recorder)
+
+    def _health_meta() -> dict:
+        """The EWMA snapshot every checkpoint meta carries (see
+        checkpoint._META_FIELDS): --resume re-seeds the detector."""
+        return monitor.meta_snapshot() if monitor is not None else {}
     use_sp = cfg.seq_parallel != "none"
     if use_sp and (not cfg.arch.startswith("vit") or cfg.model_parallel < 2):
         raise ValueError(
@@ -1054,7 +1203,8 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
             aux_loss_weight=cfg.moe_aux_weight,
             grad_accum=cfg.grad_accum,
             mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay,
-            jitter_fn=jitter_fn, mean=cfg.mean, std=cfg.std)
+            jitter_fn=jitter_fn, mean=cfg.mean, std=cfg.std,
+            health_stats=cfg.health_stats)
         eval_step = make_eval_step_auto(model, mesh, state_specs,
                                         mean=cfg.mean, std=cfg.std)
     else:
@@ -1067,7 +1217,8 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
             zero1=cfg.zero1, momentum=cfg.momentum,
             weight_decay=cfg.weight_decay,
             mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay,
-            jitter_fn=jitter_fn, mean=cfg.mean, std=cfg.std)
+            jitter_fn=jitter_fn, mean=cfg.mean, std=cfg.std,
+            health_stats=cfg.health_stats)
         eval_step = make_eval_step(model, mesh, state_specs,
                                    mean=cfg.mean, std=cfg.std)
 
@@ -1130,6 +1281,12 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
             state = place_state(state, mesh, state_specs)
             (start_epoch, resume_step, best_top1, best_top5,
              best_epoch) = _resume_point(meta)
+            if monitor is not None and monitor.seed(meta) and is_master:
+                # A resume directly into a spike must be judged against
+                # the pre-crash baseline, not an empty one.
+                print("health detector re-seeded from checkpoint "
+                      f"EWMAs (n={int(meta.get('health_ewma_n', 0))})",
+                      flush=True)
             if is_master:
                 print(f"resumed from epoch {start_epoch}"
                       + (f" step {resume_step}" if resume_step else "")
@@ -1151,6 +1308,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
     train_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
     val_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
     preempted = False
+    interrupted_at = -1  # persists past the loop (terminal status)
 
     if cfg.eval_only:
         # Validation pass on the current params (--resume /
@@ -1180,6 +1338,36 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
     # decisions: rollback verdicts ride replicated metric vectors, the
     # preemption stop is any-reduced).
     telem = TelemetrySession(cfg, is_master, logger)
+    telem.health = monitor
+    if monitor is not None:
+
+        def _on_anomaly(a: dict) -> None:
+            # Detection rides the replicated metric vector, so every
+            # host fires identically — local bookkeeping only.
+            telem.health_anomaly(a)
+            if is_master:
+                val = a.get("value")
+                base = a.get("baseline")
+                print(f"HEALTH: {a['kind']} anomaly at epoch "
+                      f"{a['epoch'] + 1} step {a['step'] + 1} — value "
+                      + ("non-finite" if val is None else f"{val:.4g}")
+                      + (f" vs EWMA baseline {base:.4g}"
+                         if base else "")
+                      + (" — rolling back to the last good checkpoint"
+                         if cfg.health_rollback else
+                         " (warn only; --health-rollback to act)"),
+                      flush=True)
+
+        monitor.on_anomaly = _on_anomaly
+    if recorder is not None:
+        recorder.note(arch=cfg.arch, global_batch=global_batch,
+                      process_count=jax.process_count(),
+                      steps_per_epoch=train_loader.steps_per_epoch,
+                      seed=cfg.seed)
+    # Live status surface (status.py): process 0 atomically rewrites
+    # runs/<run>/status.json at every --log-every boundary and epoch
+    # exit; `python -m imagent_tpu.status <log_dir>` renders it.
+    status = StatusWriter(cfg.log_dir) if is_master else None
     telem.run_start({
         "arch": cfg.arch, "global_batch": global_batch,
         "process_count": jax.process_count(),
@@ -1189,8 +1377,20 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
         "seed": cfg.seed,
     })
 
+    anomaly_hwm = [0]  # monitor.anomalies already attributed to epochs
+
     def _end_telemetry_epoch(ep: int, tm: dict,
-                             interrupted: bool = False) -> None:
+                             interrupted: bool = False,
+                             step: int | None = None) -> None:
+        if monitor is not None:
+            # Per-epoch anomaly count from the monitor's EVERY-step
+            # totals (the emission schedule is rate-limited; counting
+            # there would report 0 for epochs inside a standing
+            # streak).
+            delta = monitor.anomalies - anomaly_hwm[0]
+            if delta:
+                telem.count("health_anomalies", delta)
+            anomaly_hwm[0] = monitor.anomalies
         if pod is not None:
             # telemetry.epoch_end runs the per-host counter allgather —
             # the same class of dead-peer hang as the checkpoint
@@ -1206,7 +1406,27 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
             # dead (or a deadline tuned too tight for the fs).
             telem.gauge("hb_peer_staleness_s",
                         round(pod.max_peer_staleness(), 3))
-        telem.epoch_end(ep, tm, interrupted=interrupted)
+        record = telem.epoch_end(ep, tm, interrupted=interrupted)
+        if status is not None:
+            # Epoch-boundary status write: covers --log-every 0 runs
+            # and adds the goodput the in-epoch writes can't know yet.
+            status.write({
+                "phase": "boundary", "epoch": ep, "epochs": cfg.epochs,
+                # An interrupted epoch's true frontier, not a full
+                # epoch that never ran (progress/ETA tooling reads
+                # this; the mid-epoch checkpoint's resume_step agrees).
+                "step": (step if step is not None
+                         else train_loader.steps_per_epoch),
+                "steps_per_epoch": train_loader.steps_per_epoch,
+                "loss": tm.get("loss"), "lr": lr_for_epoch(cfg, ep),
+                "best_top1": best_top1,
+                "bad_steps": tm.get("bad_steps", 0),
+                "goodput": (record or {}).get("goodput"),
+                "degraded": bool(pod is not None and pod.degraded),
+                "interrupted": bool(interrupted),
+                "health": (monitor.snapshot()
+                           if monitor is not None else None),
+            })
 
     ckpt_commit_failures = 0  # pod-agreed failed async commits
     ckpt_fail_streak = 0      # consecutive — the storage-outage verdict
@@ -1288,7 +1508,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
              warm) = train_one_epoch(
                 cfg, mesh, train_step, state, train_loader, epoch, lr,
                 is_master, stop_check, resume_step, watchdog, telem,
-                prefetch=warm, pod=pod)
+                prefetch=warm, pod=pod, health=monitor, status=status)
             resume_step = 0  # only the first resumed epoch skips batches
             # Land the previous epoch's async checkpoint commit if it
             # has completed (non-blocking; the verdict is pod-agreed
@@ -1316,24 +1536,38 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
                 telem.count("rollbacks")
                 if rollback_streak > _MAX_ROLLBACKS:
                     raise exitcodes.RollbackGiveUpError(
-                        f"non-finite steps persisted through "
-                        f"{_MAX_ROLLBACKS} consecutive rollbacks — "
-                        "giving up (check data / lr / bf16 ranges; the "
-                        "fault reproduces on every replay)")
+                        f"non-finite or diverging steps persisted "
+                        f"through {_MAX_ROLLBACKS} consecutive "
+                        "rollbacks — giving up (check data / lr / bf16 "
+                        "ranges; the fault reproduces on every replay)")
                 t_rec = time.perf_counter()
                 _pod_gate("recovery")
                 restored = ckpt_lib.restore_resilient(cfg.ckpt_dir,
                                                       state)
                 if restored is None:
-                    # Nothing to roll back to — but the in-graph guard
-                    # skipped every bad update, so the live state is
-                    # NOT poisoned. Killing an intact run because
+                    # Nothing to roll back to. For a GUARD trip the
+                    # in-graph skip means the live state is NOT
+                    # poisoned, so killing an intact run because
                     # --save-model is off would be strictly worse than
-                    # pressing on; skip the rest of this epoch (its
-                    # remaining batches would re-fire whatever tripped
-                    # the guard) and continue, still bounded by the
-                    # rollback budget above.
-                    if is_master:
+                    # pressing on. A HEALTH trip is different — the
+                    # diverging (finite) updates WERE applied — but
+                    # with no checkpoint there is nothing to restore
+                    # either way: say so honestly and continue, still
+                    # bounded by the rollback budget above (a state
+                    # that stays diverged keeps tripping and gives up;
+                    # a survivable spike recovers).
+                    if is_master and train_m.get("health_rollback"):
+                        print("WARNING: health anomaly tripped "
+                              f"rollback in epoch {epoch + 1} but "
+                              "there is no checkpoint to roll back to "
+                              "(--save-model off?). The diverging "
+                              "updates WERE applied (unlike guard-"
+                              "skipped steps) — continuing on the "
+                              "possibly-diverged state; "
+                              f"({rollback_streak}/{_MAX_ROLLBACKS} "
+                              "consecutive strikes before giving up)",
+                              flush=True)
+                    elif is_master:
                         print(f"WARNING: {cfg.max_bad_steps} "
                               "consecutive non-finite steps in epoch "
                               f"{epoch + 1} and no checkpoint to roll "
@@ -1355,6 +1589,12 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
                 _end_telemetry_epoch(epoch, train_m)
                 (epoch, resume_step, best_top1, best_top5,
                  best_epoch) = _resume_point(meta)
+                if monitor is not None:
+                    # Replay against the restored generation's health
+                    # baseline — the anomalous observations were never
+                    # absorbed, and the checkpoint's EWMAs describe
+                    # exactly the weights now live again.
+                    monitor.seed(meta)
                 if is_master:
                     print(f"ROLLBACK {rollback_streak}/{_MAX_ROLLBACKS}"
                           f": restored checkpoint '{src}', replaying "
@@ -1375,11 +1615,13 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
                         "epoch": epoch - 1,
                         "resume_step": interrupted_at,
                         "best_top1": best_top1, "best_top5": best_top5,
-                        "best_epoch": best_epoch, **topo_meta},
+                        "best_epoch": best_epoch, **topo_meta,
+                        **_health_meta()},
                     keep_last_k=cfg.keep_last_k)
                 telem.phase("checkpoint", time.perf_counter() - t_ck)
                 telem.count("preempted")
-                _end_telemetry_epoch(epoch, train_m, interrupted=True)
+                _end_telemetry_epoch(epoch, train_m, interrupted=True,
+                                     step=interrupted_at)
                 if is_master:
                     print("preemption signal: checkpointed epoch "
                           f"{epoch + 1} at step {interrupted_at}; "
@@ -1407,11 +1649,13 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
                         state, {
                             "epoch": epoch, "best_top1": best_top1,
                             "best_top5": best_top5,
-                            "best_epoch": best_epoch, **topo_meta})
+                            "best_epoch": best_epoch, **topo_meta,
+                            **_health_meta()})
             if cfg.save_model:
                 last_meta = {"epoch": epoch, "best_top1": best_top1,
                              "best_top5": best_top5,
-                             "best_epoch": best_epoch, **topo_meta}
+                             "best_epoch": best_epoch, **topo_meta,
+                             **_health_meta()}
                 if cfg.async_ckpt:
                     # Snapshot-then-commit: the only blocking slice is
                     # the device→host copy; serialization + rotation +
@@ -1501,6 +1745,25 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None) -> dict:
         _export_torch(cfg, state, is_master, prefer_best=True)
     total_min = (time.time() - run_t0) / 60.0
     logger.final_summary(best_epoch, best_top1, best_top5, total_min)
+    if status is not None:
+        # Terminal status: a finished run must not render as a hung
+        # one ("updated Xs ago" growing forever at the last boundary).
+        status.write({
+            "phase": "preempted" if preempted else "done",
+            # Preempted: the interrupted epoch's true frontier (agrees
+            # with the mid-epoch checkpoint's resume_step); finished:
+            # the last trained epoch, complete.
+            "epoch": epoch if preempted else max(epoch - 1, 0),
+            "epochs": cfg.epochs,
+            "step": (interrupted_at
+                     if preempted and interrupted_at >= 0
+                     else train_loader.steps_per_epoch),
+            "steps_per_epoch": train_loader.steps_per_epoch,
+            "loss": train_m.get("loss"), "best_top1": best_top1,
+            "degraded": bool(pod is not None and pod.degraded),
+            "health": (monitor.snapshot()
+                       if monitor is not None else None),
+        })
     summary = {"best_top1": best_top1, "best_top5": best_top5,
                "best_epoch": best_epoch, "total_minutes": total_min,
                "final_train": train_m, "final_val": val_m,

@@ -346,6 +346,13 @@ class PodHeartbeat:
         self.dir = heartbeat.heartbeat_dir(run_dir)
         self.rank = int(rank)
         self.world = int(world)
+        # Optional pre-tombstone hook: callable(reason, exit_code,
+        # detail="") -> path-or-None. The engine wires the flight
+        # recorder's flush here, so EVERY deliberate fatal ramp (the
+        # run's handlers, the watchdog/deadman escalation threads)
+        # lands the forensic record and the tombstone references it.
+        # Must stay an opaque callable — this module is jax-free.
+        self.on_fatal = None
         self.writer = heartbeat.HeartbeatWriter(self.dir, rank,
                                                 interval_secs)
         self.monitor = DeadmanMonitor(
@@ -388,6 +395,19 @@ class PodHeartbeat:
 
     def tombstone(self, reason: str, exit_code: int,
                   detail: str = "") -> bool:
+        if self.on_fatal is not None:
+            try:
+                path = self.on_fatal(reason, exit_code, detail=detail)
+            except Exception:
+                path = None
+            if path:
+                # Reference the flight recorder from the tombstone so
+                # the forensic workflow is one hop: classify the death
+                # from the tombstone, open the named record. Detail is
+                # pre-truncated so the reference survives the writer's
+                # 500-char cap.
+                detail = ((detail[:380] + "; ") if detail else "") \
+                    + f"flightrec={os.path.basename(path)}"
         return self.writer.tombstone(
             reason, exit_code, exitcodes.is_retryable(exit_code),
             detail=detail)

@@ -44,6 +44,12 @@ Registered fault points (grep for ``faultinject.fire``):
   any rename — the live generation survives untouched and the async
   path pod-agrees the failed verdict at the next landing point instead
   of hanging or splitting the pod.
+* ``step.grad_spike`` (engine): scales one dispatch's learning rate by
+  ``factor`` (default 64) — the update ratio spikes on the spiked step
+  and the blown-up params spike the following steps' loss/grad norms,
+  all still FINITE: drives the divergence early-warning detector
+  (``telemetry/health.py``) and, with ``--health-rollback``, the
+  rollback-before-the-non-finite-guard path (``make drill-divergence``).
 * ``host.die`` (engine): abrupt ``os._exit`` mid-epoch — no tombstone,
   no cleanup, no signal handlers (the VM-reclaim / kernel-panic
   stand-in). Peers must detect this via heartbeat staleness alone

@@ -1,0 +1,246 @@
+"""Live pod status surface: ``runs/<run>/status.json`` + the
+``python -m imagent_tpu.status`` one-screen renderer.
+
+TensorBoard answers "how did the run trend"; the telemetry JSONL
+answers "what happened each epoch" — neither answers the operator's
+2 a.m. question, *"is the pod alive RIGHT NOW and is the model
+healthy?"*, without attaching tooling to a live filesystem of event
+files.  This module does:
+
+* **Writer** (process 0, inside the engine): at every ``--log-every``
+  boundary and at each epoch exit, ``StatusWriter`` atomically
+  (tmp + rename) rewrites one small ``status.json`` with the step
+  frontier, the lagged loss, the health EWMAs/anomaly counters
+  (``telemetry/health.py``), the last epoch's goodput, and the
+  degraded flag.  One tiny local file write per log interval — no
+  collectives, no device access, same cost class as the ``--log-every``
+  print it rides next to.
+* **Renderer** (the CLI): ``python -m imagent_tpu.status <run_dir>``
+  combines ``status.json`` with the out-of-band heartbeat/tombstone
+  files (``resilience/heartbeat.py``) and the last ``telemetry.jsonl``
+  epoch record into a single screen: run frontier, model health, pod
+  goodput, per-host liveness, recent anomalies.  ``--watch N``
+  refreshes every N seconds.  Reads only — safe against a live run
+  (every producer writes atomically; torn reads return the previous
+  generation).
+
+This module stays **jax-free** (asserted by ``tests/test_health.py``):
+the writer sits on the master's step loop, and the renderer must work
+on any login node / dev box with no accelerator stack at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from imagent_tpu.resilience import heartbeat
+from imagent_tpu.telemetry import events as telemetry_events
+from imagent_tpu.telemetry.events import read_json, write_json_atomic
+
+STATUS_FILENAME = "status.json"
+
+
+def status_path(log_dir: str) -> str:
+    return os.path.join(log_dir, STATUS_FILENAME)
+
+
+class StatusWriter:
+    """Atomic rewriter of the run's ``status.json`` (process 0 only —
+    the engine constructs it on the master alone)."""
+
+    def __init__(self, log_dir: str):
+        self.path = status_path(log_dir)
+        self._write_errors = 0
+
+    def write(self, payload: dict) -> None:
+        payload = dict(payload)
+        payload["t"] = round(time.time(), 3)
+        try:
+            write_json_atomic(self.path, payload)
+        except OSError as e:
+            # The status surface is advisory — storage flaking here
+            # must not touch the run. Say why, once.
+            self._write_errors += 1
+            if self._write_errors == 1:
+                print(f"WARNING: status.json write failed ({e}); the "
+                      "live status surface is stale", flush=True)
+
+
+def read_status(log_dir: str) -> dict | None:
+    """The current status record, or None when absent/torn (torn reads
+    race the atomic rename and must never raise)."""
+    return read_json(status_path(log_dir))
+
+
+# ---------------------------------------------------------------------------
+# Renderer
+# ---------------------------------------------------------------------------
+
+
+def _fmt(x, spec: str = ".4g", none: str = "-") -> str:
+    if x is None:
+        return none
+    try:
+        return format(float(x), spec)
+    except (TypeError, ValueError):
+        return str(x)
+
+
+def _age(t, now: float) -> str:
+    if not t:
+        return "?"
+    return f"{max(now - float(t), 0.0):.1f}s ago"
+
+
+def _scan_hosts(run_dir: str, now: float) -> list[str]:
+    """Per-host liveness lines from the out-of-band heartbeat dir."""
+    hb_dir = heartbeat.heartbeat_dir(run_dir)
+    lines: list[str] = []
+    try:
+        entries = sorted(os.listdir(hb_dir))
+    except OSError:
+        return lines
+    ranks = sorted({int(e.split(".")[1]) for e in entries
+                    if e.startswith(("hb.", "tombstone."))
+                    and e.split(".")[1].isdigit()})
+    for r in ranks:
+        hb = heartbeat.read_record(heartbeat.heartbeat_path(hb_dir, r))
+        ts = heartbeat.read_record(heartbeat.tombstone_path(hb_dir, r))
+        parts = [f"  host {r}:"]
+        if hb is not None:
+            phase = hb.get("phase", "?")
+            if phase == heartbeat.PHASE_DONE:
+                parts.append(f"done ({_age(hb.get('t'), now)})")
+            else:
+                parts.append(
+                    f"{phase} epoch {hb.get('epoch', -1) + 1} "
+                    f"step {hb.get('step', 0)} — beat "
+                    f"{_age(hb.get('t'), now)}")
+        else:
+            parts.append("no heartbeat")
+        if ts is not None:
+            parts.append(
+                f"| TOMBSTONE {ts.get('reason')} "
+                f"(exit {ts.get('exit_code')}, "
+                f"{'retryable' if ts.get('retryable') else 'fatal'})")
+        lines.append(" ".join(parts))
+    return lines
+
+
+def _last_epoch_record(run_dir: str) -> tuple[dict | None, dict | None,
+                                              list[dict]]:
+    """(last epoch record, run_start, recent health_anomaly events)
+    from telemetry.jsonl — resume semantics: the LAST record per type
+    wins, like benchmarks/render_curves.py."""
+    path = os.path.join(run_dir, telemetry_events.FILENAME)
+    if not os.path.isfile(path):
+        return None, None, []
+    recs = telemetry_events.read_events(path)
+    epoch_rec = run_start = None
+    anomalies: list[dict] = []
+    for rec in recs:
+        if rec.get("event") == "epoch":
+            epoch_rec = rec
+        elif rec.get("event") == "run_start":
+            run_start = rec
+        elif rec.get("event") == "health_anomaly":
+            anomalies.append(rec)
+    return epoch_rec, run_start, anomalies[-3:]
+
+
+def render(run_dir: str, now: float | None = None) -> str:
+    """The one-screen pod view. Every input is optional — a run that
+    never armed heartbeats still renders its status + telemetry."""
+    now = time.time() if now is None else now
+    st = read_status(run_dir)
+    epoch_rec, run_start, anomalies = _last_epoch_record(run_dir)
+    lines = [f"== imagent_tpu status — {run_dir} =="]
+    if run_start is not None:
+        lines.append(
+            f"run: {run_start.get('arch', '?')} "
+            f"global_batch {run_start.get('global_batch', '?')} "
+            f"x{run_start.get('process_count', '?')} host(s) "
+            f"{run_start.get('device_count', '?')} device(s)")
+    if st is None:
+        lines.append("status.json: absent (run not started, or "
+                     "--log-every 0 and no epoch boundary yet)")
+    else:
+        flag = "  ** POD DEGRADED **" if st.get("degraded") else ""
+        lines.append(
+            f"frontier: epoch {int(st.get('epoch', 0)) + 1}"
+            f"/{st.get('epochs', '?')} "
+            f"step {st.get('step', '?')}/{st.get('steps_per_epoch', '?')}"
+            f" ({st.get('phase', '?')}) — updated "
+            f"{_age(st.get('t'), now)}{flag}")
+        lines.append(
+            f"train: loss {_fmt(st.get('loss'))} "
+            f"lr {_fmt(st.get('lr'), 'g')} "
+            f"best_top1 {_fmt(st.get('best_top1'), '.3f')}")
+        h = st.get("health") or {}
+        if h:
+            lines.append(
+                "health: grad_norm ewma "
+                f"{_fmt(h.get('grad_norm_ewma'))} | update_ratio ewma "
+                f"{_fmt(h.get('update_ratio_ewma'), '.3g')} | "
+                f"loss ewma {_fmt(h.get('loss_ewma'))} | anomalies "
+                f"{h.get('anomalies', 0)} | bad steps "
+                f"{h.get('bad_steps', 0)}")
+    if epoch_rec is not None:
+        phases = epoch_rec.get("phases") or {}
+        lines.append(
+            f"last epoch ({int(epoch_rec.get('epoch', 0)) + 1}): "
+            f"goodput {_fmt(epoch_rec.get('goodput'), '.2%')} | "
+            f"input_wait {_fmt(phases.get('input_wait'), '.1f')}s | "
+            f"step p95 "
+            f"{_fmt((epoch_rec.get('step_ms') or {}).get('p95_ms'), '.1f')}"
+            f"ms | stragglers {len(epoch_rec.get('stragglers') or [])}")
+        hbm = epoch_rec.get("hbm") or {}
+        if hbm.get("bytes_in_use") is not None:
+            limit = hbm.get("bytes_limit")
+            lines.append(
+                f"hbm: {_fmt(hbm.get('peak_bytes_in_use', 0) / 1e9, '.2f')}"
+                f" GB peak"
+                + (f" / {_fmt(limit / 1e9, '.2f')} GB" if limit else ""))
+    hosts = _scan_hosts(run_dir, now)
+    if hosts:
+        lines.append("hosts:")
+        lines.extend(hosts)
+    for a in anomalies:
+        lines.append(
+            f"ANOMALY: {a.get('kind')} at epoch "
+            f"{int(a.get('epoch', 0)) + 1} step {a.get('step')} — "
+            f"value {_fmt(a.get('value'), '.3g')} vs baseline "
+            f"{_fmt(a.get('baseline'), '.3g')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m imagent_tpu.status",
+        description="One-screen live pod view: status.json + "
+                    "heartbeats + telemetry.jsonl from a run dir")
+    p.add_argument("run_dir", help="the run's --log-dir")
+    p.add_argument("--watch", type=float, default=0.0, metavar="SECS",
+                   help="refresh every SECS seconds (0 = render once)")
+    ns = p.parse_args(argv)
+    if not os.path.isdir(ns.run_dir):
+        print(f"no such run dir: {ns.run_dir}", file=sys.stderr)
+        return 2
+    while True:
+        out = render(ns.run_dir)
+        if ns.watch > 0:
+            print("\033[2J\033[H" + out, flush=True)  # clear + home
+            try:
+                time.sleep(ns.watch)
+            except KeyboardInterrupt:
+                return 0
+        else:
+            print(out, flush=True)
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

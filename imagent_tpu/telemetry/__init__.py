@@ -25,17 +25,20 @@ from imagent_tpu.telemetry.aggregate import (
 from imagent_tpu.telemetry.events import (
     SCHEMA_VERSION, TelemetryWriter, read_events,
 )
+from imagent_tpu.telemetry.flightrec import FlightRecorder
 from imagent_tpu.telemetry.goodput import (
     OVERLAP_PHASES, PHASES, GoodputAccountant,
 )
+from imagent_tpu.telemetry.health import HEALTH_FIELDS, HealthMonitor
 from imagent_tpu.telemetry.profiler import (
     ProfilerSession, hbm_stats, parse_profile_at_step,
 )
 from imagent_tpu.telemetry.sampler import StepTimeSampler
 
 __all__ = [
-    "PHASES", "OVERLAP_PHASES", "HOST_FIELDS", "SCHEMA_VERSION",
-    "GoodputAccountant",
+    "PHASES", "OVERLAP_PHASES", "HOST_FIELDS", "HEALTH_FIELDS",
+    "SCHEMA_VERSION", "GoodputAccountant", "HealthMonitor",
+    "FlightRecorder",
     "StepTimeSampler", "TelemetryWriter", "TelemetrySession",
     "ProfilerSession", "allgather_host_stats", "flag_stragglers",
     "summarize_hosts", "hbm_stats", "parse_profile_at_step",
@@ -78,6 +81,10 @@ class TelemetrySession:
         self._h2d_bytes = 0.0
         self._max_wait_s = 0.0
         self._in_epoch = False
+        # Model-health monitor (telemetry/health.py), installed by the
+        # engine when --health-stats is on; its EWMA snapshot rides the
+        # per-epoch record and the health_anomaly events land here.
+        self.health = None
 
     # ---- run lifecycle --------------------------------------------------
 
@@ -130,6 +137,19 @@ class TelemetrySession:
         if self.enabled and self._in_epoch:
             self.counters[name] = max(
                 float(self.counters.get(name, 0.0)), float(value))
+
+    def health_anomaly(self, info: dict) -> None:
+        """A divergence early-warning verdict (telemetry/health.py):
+        written as a ``health_anomaly`` event. Reached only on the
+        monitor's rate-limited emission schedule — the per-epoch
+        ``health_anomalies`` counter is fed separately by the engine
+        from the monitor's every-step totals, so epochs inside a
+        standing anomaly streak still count correctly. Detection rides
+        the REPLICATED metric vector, so every host reaches the same
+        verdict on the same step — pure local bookkeeping here, no
+        collective."""
+        if self.writer is not None:
+            self.writer.write("health_anomaly", info)
 
     def pod_degraded(self, info: dict) -> None:
         """The deadman's detection verdict: a peer died and this run is
@@ -211,6 +231,8 @@ class TelemetrySession:
             "hbm": hbm_stats(),
             "interrupted": bool(interrupted),
         }
+        if self.health is not None:
+            record["health"] = self.health.snapshot()
         if self.is_master:
             if record["stragglers"]:
                 names = ", ".join(

@@ -1,0 +1,128 @@
+"""Offline telemetry CLI: ``python -m imagent_tpu.telemetry``.
+
+Subcommands:
+
+* ``summarize <run_dir>`` — print a per-epoch goodput/health table
+  from ``runs/<run>/telemetry.jsonl`` (the torn-tail-tolerant reader
+  in ``events.py``), plus the run header and any anomaly/degraded
+  events.  Resume semantics match ``benchmarks/render_curves.py``: a
+  resumed run appends, so the LAST record per epoch wins.
+
+Pure JSONL post-processing — runs on any box with no accelerator
+stack (nothing here imports jax).  The exact table format is pinned by
+a golden-output test (``tests/test_health.py``), so downstream scripts
+may parse it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from imagent_tpu.telemetry.events import FILENAME, read_events
+
+_COLUMNS = ("epoch", "wall_s", "goodput", "input_s", "p95_ms",
+            "bad", "anomal", "gnorm_ewma", "ratio_ewma", "hbm_gb")
+_WIDTHS = (5, 8, 7, 8, 8, 4, 6, 10, 10, 7)
+
+
+def _cell(v, width: int, spec: str = "") -> str:
+    if v is None:
+        return "-".rjust(width)
+    try:
+        return format(v, spec).rjust(width)
+    except (TypeError, ValueError):
+        return str(v).rjust(width)
+
+
+def summarize(run_dir: str) -> str:
+    """The per-epoch table (one string, newline-joined)."""
+    path = os.path.join(run_dir, FILENAME)
+    if not os.path.isfile(path):
+        return f"no {FILENAME} under {run_dir}"
+    recs = read_events(path)
+    by_epoch: dict[int, dict] = {}
+    run_start = run_end = None
+    notable: list[str] = []
+    for rec in recs:
+        ev = rec.get("event")
+        if ev == "epoch":
+            by_epoch[int(rec.get("epoch", -1))] = rec  # last wins
+        elif ev == "run_start":
+            run_start = rec
+        elif ev == "run_end":
+            run_end = rec
+        elif ev == "health_anomaly":
+            notable.append(
+                f"  health_anomaly: {rec.get('kind')} at epoch "
+                f"{int(rec.get('epoch', 0)) + 1} step {rec.get('step')}")
+        elif ev == "pod_degraded":
+            notable.append(
+                f"  pod_degraded: peer {rec.get('peer')} "
+                f"({rec.get('reason')}) at epoch "
+                f"{int(rec.get('epoch', 0)) + 1}")
+    lines = []
+    if run_start is not None:
+        lines.append(
+            f"run: {run_start.get('arch', '?')} global_batch "
+            f"{run_start.get('global_batch', '?')} x"
+            f"{run_start.get('process_count', '?')} host(s), "
+            f"{run_start.get('steps_per_epoch', '?')} steps/epoch")
+    lines.append("  ".join(c.rjust(w)
+                           for c, w in zip(_COLUMNS, _WIDTHS)))
+    for epoch in sorted(by_epoch):
+        rec = by_epoch[epoch]
+        phases = rec.get("phases") or {}
+        counters = rec.get("counters") or {}
+        health = rec.get("health") or {}
+        hbm = rec.get("hbm") or {}
+        peak = hbm.get("peak_bytes_in_use")
+        cells = (
+            _cell(epoch + 1, _WIDTHS[0], "d"),
+            _cell(rec.get("wall_s"), _WIDTHS[1], ".1f"),
+            _cell(rec.get("goodput"), _WIDTHS[2], ".3f"),
+            _cell(phases.get("input_wait"), _WIDTHS[3], ".1f"),
+            _cell((rec.get("step_ms") or {}).get("p95_ms"),
+                  _WIDTHS[4], ".1f"),
+            _cell(int(counters.get("bad_steps", 0)), _WIDTHS[5], "d"),
+            _cell(int(counters.get("health_anomalies", 0)),
+                  _WIDTHS[6], "d"),
+            _cell(health.get("grad_norm_ewma"), _WIDTHS[7], ".3g"),
+            _cell(health.get("update_ratio_ewma"), _WIDTHS[8], ".3g"),
+            _cell(None if peak is None else peak / 1e9,
+                  _WIDTHS[9], ".2f"),
+        )
+        flags = ""
+        if rec.get("interrupted"):
+            flags += "  [interrupted]"
+        if rec.get("stragglers"):
+            flags += f"  [stragglers: {len(rec['stragglers'])}]"
+        lines.append("  ".join(cells) + flags)
+    lines.extend(notable)
+    if run_end is not None:
+        lines.append(
+            f"run_end: best_top1 {run_end.get('best_top1', 0.0)} "
+            f"(epoch {int(run_end.get('best_epoch', -1)) + 1}), "
+            f"{run_end.get('total_minutes', 0.0)} min, rollbacks "
+            f"{run_end.get('rollbacks', 0)}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m imagent_tpu.telemetry",
+        description="Offline telemetry.jsonl tooling")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser("summarize",
+                        help="per-epoch goodput/health table")
+    ps.add_argument("run_dir", help="the run's --log-dir")
+    ns = p.parse_args(argv)
+    if ns.cmd == "summarize":
+        print(summarize(ns.run_dir), flush=True)
+        return 0
+    return 2  # unreachable: argparse enforces the subcommand
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,9 +12,23 @@ Event types:
   process count, device count).
 * ``epoch``      — the per-epoch record: wall, goodput phases
   (``goodput.PHASES``), step-time percentiles, pod-aggregated per-host
-  stats, straggler flags, resilience counters, HBM stats.
+  stats, straggler flags, resilience counters, HBM stats, and (when
+  ``--health-stats`` is on) the model-health EWMA snapshot under
+  ``health`` (``telemetry/health.py``).
 * ``profile``    — a ``--profile-at-step`` window opened/closed.
+* ``health_anomaly`` — a divergence early-warning verdict: the spiked
+  metric (``kind`` ∈ ``health.ANOMALY_KINDS``), its value, the EWMA
+  baseline it exceeded, and the (epoch, step) it fired at — BEFORE the
+  non-finite guard would have noticed anything.
+* ``pod_degraded`` — the deadman's peer-death verdict (see
+  ``TelemetrySession.pod_degraded``).
 * ``run_end``    — run summary totals.
+
+Schema note: the ``health`` sub-record and the two event types above
+are ADDITIONS (consumers ignore unknown keys/events), not a
+``SCHEMA_VERSION`` bump — a bump would make old readers drop every
+record.  ``python -m imagent_tpu.telemetry summarize <run_dir>`` is
+the offline reader for the whole log.
 
 Every record carries ``{"event": <type>, "schema": SCHEMA_VERSION,
 "t": <unix seconds>}``.  Consumers must ignore unknown keys and check
@@ -25,11 +39,63 @@ bumps).  ``benchmarks/render_curves.py`` is the reference reader.
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
 
 SCHEMA_VERSION = 1
 FILENAME = "telemetry.jsonl"
+
+
+def jsonsafe(obj):
+    """Strict-JSON mirror of ``obj``: numpy scalars/arrays → Python,
+    non-finite floats → None. The shared sanitizer for every
+    observability artifact that must parse under strict readers
+    (status.json, flightrec.<rank>.json) — the record of a dying run
+    is precisely where NaN/Inf live, and ``json.dumps`` would happily
+    emit bare ``NaN`` tokens most parsers reject."""
+    if isinstance(obj, dict):
+        return {str(k): jsonsafe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [jsonsafe(v) for v in obj]
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    item = getattr(obj, "item", None)
+    if item is not None and getattr(obj, "shape", None) == ():
+        return jsonsafe(item())  # numpy scalar
+    tolist = getattr(obj, "tolist", None)
+    if tolist is not None:
+        # numpy array: a TypeError from json.dump on the fatal-exit
+        # ramp would mask the actual cause of death.
+        return jsonsafe(tolist())
+    return obj
+
+
+def write_json_atomic(path: str, payload: dict,
+                      fsync: bool = False) -> None:
+    """Land ``payload`` at ``path`` via tmp + rename, strict-JSON
+    sanitized — concurrent readers see the previous generation or
+    this one, never a torn file. ``fsync`` for records that must
+    survive the imminent process death (the flight recorder)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(jsonsafe(payload), f)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_json(path: str) -> dict | None:
+    """One JSON dict, or None when absent/torn/not-a-dict — torn reads
+    race the atomic rename above and must never raise."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return None
 
 
 def _jsonable(obj):

@@ -1,0 +1,158 @@
+"""Crash flight recorder: the last N lagged step/health records,
+flushed on every fatal exit path.
+
+When a run dies — watchdog hard-exit, rollback give-up, peer death,
+storage outage, unhandled exception — the stdout log says *that* it
+died; the question an operator actually asks is *what the model was
+doing in the seconds before*.  This module keeps a fixed-size ring of
+the health records the ``HealthMonitor`` observes (one tiny dict per
+lagged metric vector: loss, grad/param norms, update ratio, the
+bad-step flag, any anomaly verdict) and, on the fatal exit ramps,
+lands it as ``<log_dir>/flightrec.<rank>.json`` next to the heartbeat
+tombstone that references it.
+
+Write-once discipline (the tombstone's rule): the FIRST flush wins —
+later handlers on the same unwind are echoes of the same death and
+must not overwrite the forensic record of the first cause.  The file
+is written atomically (tmp + rename) and is strict-JSON parseable:
+non-finite floats are nulled at record time (``health._finite``) and
+again at flush, because the record of a dying run is precisely where
+NaN/Inf live.
+
+Like the telemetry sampler and the heartbeat writer, this module is on
+the per-(lagged-)step path and on the must-work-while-everything-else-
+is-wedged exit path, so it stays **jax-free** (asserted by
+``tests/test_health.py``): ``record()`` is one dict store into a
+preallocated ring — no I/O, no device handles; all I/O happens in
+``flush()``, once, at death.
+
+A module-global active recorder (the ``deadman._ACTIVE`` pattern) lets
+exit ramps that have no handle on the engine's state — the watchdog's
+escalation thread, the deadman's hard-exit — flush without plumbing:
+``activate()`` / ``flush_active()``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from imagent_tpu.telemetry.events import (
+    jsonsafe, read_json, write_json_atomic,
+)
+
+FILENAME_FMT = "flightrec.{rank}.json"
+DEFAULT_CAPACITY = 256
+
+_ACTIVE: "FlightRecorder | None" = None
+
+
+def flightrec_path(log_dir: str, rank: int) -> str:
+    return os.path.join(log_dir, FILENAME_FMT.format(rank=int(rank)))
+
+
+def activate(rec: "FlightRecorder | None") -> None:
+    """Install ``rec`` as the process-global recorder fatal exit ramps
+    flush through ``flush_active``."""
+    global _ACTIVE
+    _ACTIVE = rec
+
+
+def deactivate() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def flush_active(reason: str, exit_code: int,
+                 detail: str = "") -> str | None:
+    """Flush the active recorder (no-op → None when none installed).
+    Returns the flushed file's path — exit ramps reference it from the
+    tombstone ``detail``."""
+    rec = _ACTIVE
+    if rec is None:
+        return None
+    return rec.flush(reason, exit_code, detail=detail)
+
+
+class FlightRecorder:
+    """Preallocated ring of per-step records + the fatal-exit flush."""
+
+    def __init__(self, log_dir: str, rank: int = 0,
+                 capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError("flight recorder capacity must be >= 1")
+        self.path = flightrec_path(log_dir, rank)
+        self.rank = int(rank)
+        self.capacity = int(capacity)
+        self._ring: list = [None] * self.capacity
+        self._i = 0        # next write slot
+        self._n = 0        # total records ever seen
+        self.context: dict = {}  # run-level facts (arch, topology...)
+        self.flushed_to: str | None = None
+        # Flushes race by design: the watchdog/deadman escalation
+        # THREADS and the main thread's exception handlers are all
+        # exit ramps. The lock makes first-cause-wins real — without
+        # it two racers share the per-pid tmp file and can publish a
+        # truncated record on exactly the path built for forensics.
+        self._flush_lock = threading.Lock()
+
+    def note(self, **kw) -> None:
+        """Merge run-level context into the flushed header (cheap)."""
+        self.context.update(kw)
+
+    def record(self, rec: dict) -> None:
+        """One lagged step record. O(1): a slot store and two ints —
+        no allocation beyond the caller's dict, no I/O."""
+        self._ring[self._i] = rec
+        self._i = (self._i + 1) % self.capacity
+        self._n += 1
+
+    def records(self) -> list:
+        """Buffered records, oldest first."""
+        if self._n < self.capacity:
+            return [r for r in self._ring[:self._i]]
+        return (self._ring[self._i:] + self._ring[:self._i])
+
+    def flush(self, reason: str, exit_code: int,
+              detail: str = "") -> str | None:
+        """Land the ring as ``flightrec.<rank>.json`` (atomic; first
+        cause wins). Returns the path (also on later no-op calls — the
+        caller still wants to reference the existing record), or None
+        when even the write failed (dead storage: the tombstone's
+        staleness fallback story applies)."""
+        with self._flush_lock:
+            return self._flush_locked(reason, exit_code, detail)
+
+    def _flush_locked(self, reason: str, exit_code: int,
+                      detail: str) -> str | None:
+        if self.flushed_to is not None:
+            return self.flushed_to
+        payload = {
+            "version": 1,
+            "rank": self.rank,
+            "pid": os.getpid(),
+            "t": round(time.time(), 3),
+            "reason": str(reason),
+            "exit_code": int(exit_code),
+            "detail": str(detail)[:500],
+            "context": jsonsafe(self.context),
+            "records_seen": self._n,
+            "records": jsonsafe(self.records()),
+        }
+        try:
+            # fsync: the process is about to _exit — the record must
+            # already be durable.
+            write_json_atomic(self.path, payload, fsync=True)
+        except OSError as e:
+            print(f"WARNING: flight recorder flush failed ({e}); the "
+                  "stdout log is the only forensic record", flush=True)
+            return None
+        self.flushed_to = self.path
+        return self.path
+
+
+def read_flightrec(path: str) -> dict | None:
+    """Parse a flight-recorder file; None when absent/torn (the flush
+    is atomic, so torn means a partial tmp from a dying write)."""
+    return read_json(path)

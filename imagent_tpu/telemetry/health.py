@@ -1,0 +1,267 @@
+"""Model-health statistics: host-side EWMAs + divergence early-warning.
+
+The reference run silently overfit (99.4% train vs ~60% val top-1) and
+its only health signal — the binary non-finite guard — fires after the
+update is already garbage.  This module watches the health scalars the
+compiled step now appends to the replicated metric vector
+(``train.HEALTH_FIELDS``: global grad-norm, param-norm, and the update
+ratio ‖Δp‖/‖p‖) and answers the question the guard cannot: *is this run
+drifting toward divergence while every step is still finite?*
+
+Detection model: each scalar keeps a trailing EWMA baseline; an
+observation exceeding ``spike_factor ×`` its baseline (after a warmup
+of clean steps) is an anomaly.  Anomalous observations are NOT absorbed
+into the baseline — a ramping divergence must not normalize itself into
+invisibility.  Because the observations ride the REPLICATED metric
+vector that every host consumes in the same order (the engine's
+``_GUARD_LAG`` lagged frontier), every host's monitor reaches the same
+verdict on the same step — so ``--health-rollback`` can feed the
+existing rollback machinery with no extra collective, exactly like the
+non-finite guard's n==0 flag.
+
+EWMA persistence: ``meta_snapshot()`` flattens the baselines into the
+checkpoint meta fields (``checkpoint._META_FIELDS``) and ``seed()``
+restores them — a ``--resume`` directly into a spike must be judged
+against the PRE-crash baseline, not a cold-started empty one.
+
+This module is consumed once per (lagged) training step and must stay
+jax-free: no device handles, no syncs, O(1) per observation — the same
+contract as ``telemetry/sampler.py``, asserted by
+``tests/test_health.py``.
+"""
+
+from __future__ import annotations
+
+import math
+
+# Names of the scalars the train step appends past the classic
+# [loss_sum, top1, top5, n] metric head (same order as train.py's
+# in-graph jnp.stack — the two must agree; pinned by tests).
+HEALTH_FIELDS = ("grad_norm", "param_norm", "update_ratio")
+
+# Anomaly kinds observe() can report.
+ANOMALY_KINDS = ("loss_spike", "grad_spike", "update_spike",
+                 "non_finite")
+
+
+class Ewma:
+    """Scalar exponential moving average (no bias correction — the
+    warmup gate below covers the cold-start window instead)."""
+
+    def __init__(self, beta: float = 0.98):
+        if not 0.0 < beta < 1.0:
+            raise ValueError("EWMA beta must be in (0, 1)")
+        self.beta = float(beta)
+        self.value: float | None = None
+        self.n = 0
+
+    def update(self, x: float) -> None:
+        x = float(x)
+        if not math.isfinite(x):
+            return  # non-finite observations never enter the baseline
+        if self.value is None:
+            self.value = x
+        else:
+            self.value = self.beta * self.value + (1.0 - self.beta) * x
+        self.n += 1
+
+    def seed(self, value: float, n: int) -> None:
+        """Restore a persisted baseline (checkpoint meta round-trip)."""
+        if n > 0 and math.isfinite(float(value)):
+            self.value = float(value)
+            self.n = int(n)
+
+
+class HealthMonitor:
+    """Divergence early-warning over the lagged per-step health stats.
+
+    ``observe()`` is the engine-facing surface (one call per consumed
+    metric vector): it classifies the observation against the trailing
+    EWMA baselines, updates them on clean steps, mirrors the record
+    into the flight recorder, and returns an anomaly dict (or None).
+    The caller decides policy: warn always; trip the rollback when
+    ``--health-rollback`` armed.
+
+    ``grad_spike_factor`` / ``loss_spike_factor`` — an observation this
+    many times its baseline is anomalous (0 disables that check; the
+    update ratio shares the grad factor, since both measure update
+    scale). ``warmup_steps`` clean observations must accumulate before
+    any verdict — an empty baseline judges nothing.
+
+    Every anomalous step is counted, recorded in the flight-recorder
+    ring, and RETURNED (the caller's rollback trip keys on the step
+    itself), but ``on_anomaly`` — the telemetry event + stdout warning
+    — fires only for the first step of an anomaly streak and then once
+    per ``EMIT_EVERY`` consecutive anomalous steps: in warn-only mode
+    a run that settles into a permanently-anomalous regime must not
+    flood its own event log with one verdict per remaining step.
+    """
+
+    EMIT_EVERY = 1000  # repeat-verdict cadence inside one streak
+
+    def __init__(self, grad_spike_factor: float = 10.0,
+                 loss_spike_factor: float = 10.0,
+                 warmup_steps: int = 20, beta: float = 0.98,
+                 recorder=None, on_anomaly=None):
+        if warmup_steps < 1:
+            raise ValueError("health warmup must be >= 1 step")
+        if grad_spike_factor < 0 or loss_spike_factor < 0:
+            raise ValueError("health spike factors must be >= 0 "
+                             "(0 disables the check)")
+        self.grad_spike_factor = float(grad_spike_factor)
+        self.loss_spike_factor = float(loss_spike_factor)
+        self.warmup_steps = int(warmup_steps)
+        self.loss = Ewma(beta)
+        self.grad = Ewma(beta)
+        self.ratio = Ewma(beta)
+        self.recorder = recorder      # telemetry/flightrec.FlightRecorder
+        self.on_anomaly = on_anomaly  # callable(anomaly_dict) or None
+        self.anomalies = 0            # run total (every anomalous step)
+        self.bad_steps = 0            # run total
+        self._anomaly_streak = 0      # consecutive — the emit limiter
+        self.last: dict | None = None  # newest observation (status.json)
+
+    # ---- per-step surface (host arithmetic only — no jax) ---------------
+
+    @property
+    def ready(self) -> bool:
+        """Baseline warm enough to judge an observation."""
+        return self.loss.n >= self.warmup_steps
+
+    def _classify(self, loss: float, grad_norm: float,
+                  param_norm: float, update_ratio: float
+                  ) -> tuple[str, float, float] | None:
+        """(kind, value, baseline) for the first tripped check, else
+        None. Ordered most-specific first: a non-finite health scalar
+        is its own verdict regardless of baselines — param_norm
+        included, because a params fp32 overflow (pnorm2 = inf) makes
+        update_ratio = dnorm/inf = 0.0, which would otherwise SUPPRESS
+        the update_spike check in exactly the blown-up-weights regime
+        this detector exists for. The reported value is the offending
+        scalar itself (nulled to None by ``_finite`` downstream, so
+        the emitted verdict never shows a normal-looking number for a
+        non-finite anomaly)."""
+        for scalar in (grad_norm, update_ratio, param_norm, loss):
+            if not math.isfinite(scalar):
+                return ("non_finite", scalar, 0.0)
+        if not self.ready:
+            return None
+        f = self.grad_spike_factor
+        if f > 0 and self.grad.value and grad_norm > f * self.grad.value:
+            return ("grad_spike", grad_norm, self.grad.value)
+        if f > 0 and self.ratio.value \
+                and update_ratio > f * self.ratio.value:
+            return ("update_spike", update_ratio, self.ratio.value)
+        lf = self.loss_spike_factor
+        if lf > 0 and self.loss.value and loss > lf * self.loss.value:
+            return ("loss_spike", loss, self.loss.value)
+        return None
+
+    def observe(self, epoch: int, step: int, loss: float,
+                grad_norm: float, param_norm: float,
+                update_ratio: float, bad: bool = False,
+                t: float | None = None) -> dict | None:
+        """One lagged metric vector consumed. Returns the anomaly dict
+        (also passed to ``on_anomaly``) or None."""
+        rec = {"epoch": int(epoch), "step": int(step),
+               "loss": float(loss), "grad_norm": float(grad_norm),
+               "param_norm": float(param_norm),
+               "update_ratio": float(update_ratio), "bad": bool(bad)}
+        if t is not None:
+            rec["t"] = float(t)
+        anomaly = None
+        if bad:
+            # The in-graph guard already skipped this update (metrics
+            # zeroed, n == 0) — its zeros must not dilute the baseline,
+            # and the guard owns the rollback policy for it.
+            self.bad_steps += 1
+        else:
+            verdict = self._classify(loss, grad_norm, param_norm,
+                                     update_ratio)
+            if verdict is not None:
+                kind, value, baseline = verdict
+                self.anomalies += 1
+                self._anomaly_streak += 1
+                rec["anomaly"] = kind
+                # EVERY anomalous step returns a verdict — the caller's
+                # rollback trip must fire on the step, not on the emit
+                # schedule below.
+                anomaly = {
+                    "kind": kind, "epoch": int(epoch),
+                    "step": int(step),
+                    "value": _finite(value),
+                    "baseline": _finite(baseline),
+                    "loss": _finite(loss),
+                    "grad_norm": _finite(grad_norm),
+                    "update_ratio": _finite(update_ratio),
+                    "streak": self._anomaly_streak,
+                }
+            else:
+                # Clean step: absorb into the trailing baselines.
+                self._anomaly_streak = 0
+                self.loss.update(loss)
+                self.grad.update(grad_norm)
+                self.ratio.update(update_ratio)
+        self.last = rec
+        if self.recorder is not None:
+            self.recorder.record(rec)
+        # Emit limiter (on_anomaly = telemetry event + stdout WARN
+        # only): a persistent anomalous regime in warn-only mode —
+        # baseline frozen by design above — must not flood the event
+        # log with one verdict per remaining step. First step of a
+        # streak, then once per EMIT_EVERY; every step is still
+        # counted, returned, and ringed.
+        if (anomaly is not None and self.on_anomaly is not None
+                and (self._anomaly_streak == 1
+                     or self._anomaly_streak % self.EMIT_EVERY == 0)):
+            self.on_anomaly(anomaly)
+        return anomaly
+
+    # ---- persistence (checkpoint meta) ----------------------------------
+
+    def snapshot(self) -> dict:
+        """The EWMAs + counters for status.json / telemetry records."""
+        return {
+            "loss_ewma": _finite(self.loss.value),
+            "grad_norm_ewma": _finite(self.grad.value),
+            "update_ratio_ewma": _finite(self.ratio.value),
+            "ewma_n": int(self.loss.n),
+            "anomalies": int(self.anomalies),
+            "bad_steps": int(self.bad_steps),
+        }
+
+    def meta_snapshot(self) -> dict:
+        """The baselines flattened into checkpoint meta scalars
+        (``checkpoint._META_FIELDS`` — numeric, defaulting to 0)."""
+        return {
+            "health_loss_ewma": float(self.loss.value or 0.0),
+            "health_grad_ewma": float(self.grad.value or 0.0),
+            "health_ratio_ewma": float(self.ratio.value or 0.0),
+            "health_ewma_n": int(self.loss.n),
+        }
+
+    def seed(self, meta: dict) -> bool:
+        """Re-seed the baselines from checkpoint meta — a resume (or a
+        rollback replay) judges the first post-restore steps against
+        the PRE-crash baseline instead of cold-starting blind. Returns
+        True when a persisted baseline was actually adopted."""
+        # The restored generation starts a fresh incident history
+        # either way: a pre-restore streak must not rate-limit the
+        # replay's first verdict.
+        self._anomaly_streak = 0
+        n = int(meta.get("health_ewma_n", 0) or 0)
+        if n <= 0:
+            return False
+        self.loss.seed(meta.get("health_loss_ewma", 0.0), n)
+        self.grad.seed(meta.get("health_grad_ewma", 0.0), n)
+        self.ratio.seed(meta.get("health_ratio_ewma", 0.0), n)
+        return True
+
+
+def _finite(x) -> float | None:
+    """JSON-safe float: non-finite → None (json.dumps would otherwise
+    emit bare NaN/Infinity, which strict parsers reject)."""
+    if x is None:
+        return None
+    x = float(x)
+    return x if math.isfinite(x) else None

@@ -124,4 +124,13 @@ def hbm_stats() -> dict | None:
         return None
     keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
     out = {k: int(stats[k]) for k in keep if k in stats}
-    return out or None
+    if not out:
+        return None
+    if out.get("bytes_limit"):
+        # Peak-fraction gauge: the headroom number an operator tunes
+        # batch size / remat / fused kernels against, without opening
+        # a profiler trace.
+        out["utilization"] = round(
+            out.get("peak_bytes_in_use", out.get("bytes_in_use", 0))
+            / out["bytes_limit"], 4)
+    return out

@@ -282,17 +282,65 @@ def masked_eval_metrics(logits, labels, mask) -> jnp.ndarray:
     return jnp.stack([per_sample.sum(), c1, c5, mask.sum()])
 
 
-def _nonfinite_local(grads, metrics) -> jnp.ndarray:
+# Health scalars appended past the classic [loss_sum, top1, top5, n]
+# metric head when the step builders get health_stats=True — order is
+# the wire format the host-side monitor reads (telemetry/health.py).
+HEALTH_FIELDS = ("grad_norm", "param_norm", "update_ratio")
+
+
+def _sq_sum(tree) -> jnp.ndarray:
+    """One reduced fp32 scalar: the sum of squares over every leaf.
+    The primitive both the non-finite guard and the health stats are
+    built from — non-finite values propagate into it, and its sqrt is
+    the tree's global L2 norm."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return sum((jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in leaves), jnp.float32(0.0))
+
+
+def _nonfinite_local(gnorm2, metrics) -> jnp.ndarray:
     """Scalar bool: this shard's step produced a non-finite loss or
-    gradient. One fp32 square-sum per leaf — non-finite values propagate
-    into the norm, so a single reduced scalar answers for the whole
-    tree (an fp32 overflow of the norm itself flags the step too, which
-    is the right call: such a step is garbage either way)."""
-    leaves = jax.tree_util.tree_leaves(grads)
-    gnorm2 = sum((jnp.sum(jnp.square(g.astype(jnp.float32)))
-                  for g in leaves), jnp.float32(0.0))
+    gradient. ``gnorm2`` is the gradient tree's ``_sq_sum`` (shared
+    with the health stats, so the guard pays for it exactly once) —
+    non-finite values propagate into the norm, so a single reduced
+    scalar answers for the whole tree (an fp32 overflow of the norm
+    itself flags the step too, which is the right call: such a step is
+    garbage either way)."""
     return jnp.logical_not(jnp.isfinite(gnorm2)
                            & jnp.all(jnp.isfinite(metrics)))
+
+
+def _health_stats(gnorm2, params, new_params, reduce_axes=None
+                  ) -> jnp.ndarray:
+    """``[grad_norm, param_norm, update_ratio]`` (``HEALTH_FIELDS``)
+    computed in-graph from square-sums the step already holds — the
+    model-health tail of the replicated metric vector. No host sync:
+    these three floats ride the same lagged D2H fetch as the loss.
+
+    ``reduce_axes`` (the explicit shard_map path): per-shard square
+    sums are ``psum``-ed over the model/pipe axes so sharded leaves
+    contribute exactly once. On the pure data-parallel path both axes
+    are size 1 and the psum is the identity (norms exact). In
+    model-parallel configs a leaf REPLICATED over a reduce axis is
+    counted axis-size times — a constant inflation that cancels in the
+    EWMA-relative detection (and cancels exactly in update_ratio,
+    whose numerator and denominator inflate together).
+
+    Non-finite inputs are passed through untouched: on a guarded-out
+    step the norms carry the explosion's magnitude (or its NaN) to the
+    flight recorder, while the host keys the skip on n == 0 as always.
+    """
+    pnorm2 = _sq_sum(params)
+    dnorm2 = _sq_sum(jax.tree.map(
+        lambda new, old: new.astype(jnp.float32)
+        - old.astype(jnp.float32), new_params, params))
+    if reduce_axes is not None:
+        gnorm2 = lax.psum(gnorm2, reduce_axes)
+        pnorm2 = lax.psum(pnorm2, reduce_axes)
+        dnorm2 = lax.psum(dnorm2, reduce_axes)
+    pnorm = jnp.sqrt(pnorm2)
+    return jnp.stack([jnp.sqrt(gnorm2), pnorm,
+                      jnp.sqrt(dnorm2) / (pnorm + jnp.float32(1e-12))])
 
 
 def _skip_if_bad(ok, new_tree, old_tree):
@@ -357,8 +405,16 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
                     mix_seed: int = 0,
                     ema_decay: float = 0.0,
                     jitter_fn: Callable | None = None,
-                    mean=None, std=None) -> Callable:
+                    mean=None, std=None,
+                    health_stats: bool = False) -> Callable:
     """Build the jitted SPMD train step.
+
+    ``health_stats``: append ``HEALTH_FIELDS`` (global grad-norm,
+    param-norm, update-ratio ‖Δp‖/‖p‖) to the replicated metric
+    vector, computed inside the compiled step from the square-sums the
+    non-finite guard already pays for — model-health observability
+    with zero added host syncs (the engine consumes them on the same
+    ``_GUARD_LAG`` lagged frontier; see ``telemetry/health.py``).
 
     ``mean``/``std`` (both or neither): enable the in-graph input stage
     (``make_input_prep``) — the batch arrives on the raw [0, 255] wire
@@ -495,7 +551,8 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         # the update is skipped in-graph — no host sync; the engine
         # reads the verdict from the zeroed metric vector (n == 0, which
         # no real step can produce) and handles rollback policy.
-        bad = _nonfinite_local(grads, local).astype(jnp.float32)
+        gnorm2 = _sq_sum(grads)
+        bad = _nonfinite_local(gnorm2, local).astype(jnp.float32)
         ok = lax.psum(bad, (DATA_AXIS, PIPE_AXIS, MODEL_AXIS)) == 0.0
 
         if zero1:
@@ -511,6 +568,16 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
 
         metrics = lax.psum(jnp.where(ok, local, jnp.zeros_like(local)),
                            DATA_AXIS)
+        if health_stats:
+            # Before the skip-select below: the norms describe the
+            # ATTEMPTED update (on a guarded-out step they carry the
+            # explosion's magnitude to the flight recorder; the n == 0
+            # head still tells the host the update never applied).
+            # Post-pmean grads and replicated params are identical on
+            # every data shard, so only model/pipe need reducing.
+            metrics = jnp.concatenate([metrics, _health_stats(
+                gnorm2, state.params, new_params,
+                reduce_axes=(PIPE_AXIS, MODEL_AXIS))])
 
         new_ema = state.ema_params
         new_ema_bs = state.ema_batch_stats
@@ -566,8 +633,13 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
                          mix_seed: int = 0,
                          ema_decay: float = 0.0,
                          jitter_fn: Callable | None = None,
-                         mean=None, std=None) -> Callable:
+                         mean=None, std=None,
+                         health_stats: bool = False) -> Callable:
     """FSDP train step via the XLA SPMD partitioner (``parallel/fsdp.py``).
+
+    ``health_stats``: same ``HEALTH_FIELDS`` metric tail as
+    ``make_train_step`` — here the partitioner sees logical arrays, so
+    the square-sums are globally exact with no explicit psum.
 
     ``mean``/``std``: same in-graph input stage as ``make_train_step``
     (raw-scale wire batch dequantized, jittered, normalized in-graph).
@@ -641,12 +713,16 @@ def make_train_step_auto(model, optimizer: optax.GradientTransformation,
         # Non-finite step guard — same semantics as the explicit path;
         # the partitioner sees logical arrays, so no psum is needed for
         # the verdict to be globally agreed.
-        ok = jnp.logical_not(_nonfinite_local(grads, metrics))
+        gnorm2 = _sq_sum(grads)
+        ok = jnp.logical_not(_nonfinite_local(gnorm2, metrics))
         metrics = jnp.where(ok, metrics, jnp.zeros_like(metrics))
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params)
         new_params = optax.apply_updates(
             state.params, jax.tree.map(lambda u: -lr * u, updates))
+        if health_stats:
+            metrics = jnp.concatenate([
+                metrics, _health_stats(gnorm2, state.params, new_params)])
         new_ema = state.ema_params
         new_ema_bs = state.ema_batch_stats
         if ema_decay > 0.0:

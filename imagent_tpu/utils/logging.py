@@ -113,7 +113,35 @@ class TrainLogger:
         if "peak_bytes_in_use" in hbm:
             w.add_scalar("hbm/peak_mb",
                          hbm["peak_bytes_in_use"] / 1e6, epoch)
+        if "bytes_limit" in hbm:
+            w.add_scalar("hbm/limit_mb", hbm["bytes_limit"] / 1e6,
+                         epoch)
+        if "utilization" in hbm:
+            # Peak fraction of the device's HBM: the headroom gauge
+            # for batch-size / remat / fused-kernel tuning.
+            w.add_scalar("hbm/utilization", hbm["utilization"], epoch)
         counters = record.get("counters") or {}
+        health = record.get("health") or {}
+        if health:
+            # Model-health series (telemetry/health.py EWMAs +
+            # counters): the curves that show a run drifting toward
+            # divergence while every step is still finite. The count
+            # series plot THIS EPOCH's events (the per-epoch telemetry
+            # counters, reset each epoch) — the health{} block's
+            # anomalies/bad_steps are run totals for the status
+            # surface, which would render as a misleading
+            # ever-climbing TB curve.
+            for key, tag in (("loss_ewma", "health/loss_ewma"),
+                             ("grad_norm_ewma",
+                              "health/grad_norm_ewma"),
+                             ("update_ratio_ewma",
+                              "health/update_ratio_ewma")):
+                if health.get(key) is not None:
+                    w.add_scalar(tag, health[key], epoch)
+            w.add_scalar("health/anomalies",
+                         counters.get("health_anomalies", 0), epoch)
+            w.add_scalar("health/bad_steps",
+                         counters.get("bad_steps", 0), epoch)
         if "hb_peer_staleness_s" in counters:
             # Peak peer-heartbeat age the deadman saw this epoch:
             # trending toward --peer-deadline-secs = a host about to be

@@ -46,9 +46,11 @@ def main() -> int:
     # background-barrier async save used to abort on. (The BEST save
     # is a blocking orbax save — main thread idle while it finalizes,
     # so no cross-thread collective interleave either.)
+    # log_every=2: the live status surface gets mid-epoch writes too
+    # (the parent renders `python -m imagent_tpu.status` on the run).
     cfg = Config(arch="resnet18", image_size=16, num_classes=4,
                  batch_size=4, epochs=2, lr=0.05, dataset="synthetic",
-                 synthetic_size=64, workers=0, bf16=False, log_every=0,
+                 synthetic_size=64, workers=0, bf16=False, log_every=2,
                  seed=0, save_model=True, keep_last_k=1, backend="cpu",
                  eval_every=2,
                  log_dir=os.path.join(scratch, "tb"),
@@ -61,6 +63,8 @@ def main() -> int:
             scratch, "ck", "last", "snapshot.json"))
         assert not os.path.exists(os.path.join(
             scratch, "ck", "last.pending.json"))
+        assert os.path.isfile(os.path.join(scratch, "tb",
+                                           "status.json"))
     print(f"RUN_OK rank={rank} best_epoch={result['best_epoch']}",
           flush=True)
 

@@ -222,6 +222,101 @@ def test_commit_fail_falls_back_to_previous_generation(tmp_path,
     assert not (tmp_path / "ck" / "last.staging").exists()
 
 
+def test_divergence_drill_health_rollback_beats_guard(tmp_path,
+                                                      capsys):
+    """THE divergence drill (make drill-divergence): epoch 0 trains
+    clean and checkpoints; epoch 1's second step gets its lr scaled
+    x64 (step.grad_spike) — every step stays FINITE, so the non-finite
+    guard is blind, but the update-ratio spikes ~64x its EWMA baseline
+    and the early-warning detector must catch it on the lagged
+    frontier, emit a health_anomaly telemetry event, and (with
+    --health-rollback) restore the last good checkpoint BEFORE the
+    guard could ever fire. The replay (fault expired) completes
+    clean."""
+    import json
+
+    result = run(_cfg(tmp_path, faults="step.grad_spike:after=5",
+                      health_rollback=True, health_warmup_steps=3,
+                      max_bad_steps=2))
+    assert result["rollbacks"] == 1
+    assert result["preempted"] is False
+    assert result["best_epoch"] >= 0
+    out = capsys.readouterr().out
+    assert "FAULT step.grad_spike" in out
+    assert "HEALTH: update_spike anomaly" in out
+    assert "rolling back to the last good checkpoint" in out
+    assert "ROLLBACK 1/" in out
+    # The whole point: the divergence was caught while every step was
+    # still finite — the guard never saw anything.
+    assert "non-finite step skipped" not in out
+    # The verdict is durable in the event log, before the rollback.
+    from imagent_tpu.telemetry.events import read_events
+    events = read_events(str(tmp_path / "tb" / "telemetry.jsonl"))
+    anomalies = [e for e in events if e["event"] == "health_anomaly"]
+    assert anomalies and anomalies[0]["kind"] == "update_spike"
+    assert anomalies[0]["baseline"] > 0
+    assert anomalies[0]["value"] > 10 * anomalies[0]["baseline"]
+    # The post-rollback checkpoint meta carries the re-warmed EWMAs a
+    # --resume would re-seed the detector from.
+    meta = json.loads((tmp_path / "ck" / "last_meta.json").read_text())
+    assert meta["health_ewma_n"] > 0
+    assert meta["health_grad_ewma"] > 0
+
+
+def test_divergence_warn_only_without_health_rollback(tmp_path,
+                                                      capsys):
+    """Default policy: the same spike only warns (anomaly event +
+    stdout) — no rollback, the run completes."""
+    result = run(_cfg(tmp_path, faults="step.grad_spike:after=5",
+                      health_warmup_steps=3, max_bad_steps=2))
+    assert result["rollbacks"] == 0
+    out = capsys.readouterr().out
+    assert "HEALTH: update_spike anomaly" in out
+    assert "warn only; --health-rollback to act" in out
+
+
+def test_divergence_without_checkpoint_warns_honestly(tmp_path,
+                                                      capsys):
+    """Health trip with nothing to roll back to: unlike guard-skipped
+    steps the diverging updates WERE applied, so the fallback must say
+    so (not claim 'state unpoisoned') and continue bounded by the
+    rollback budget."""
+    result = run(_cfg(tmp_path, save_model=False,
+                      faults="step.grad_spike:after=5",
+                      health_rollback=True, health_warmup_steps=3,
+                      max_bad_steps=2))
+    assert result["rollbacks"] >= 1
+    out = capsys.readouterr().out
+    assert "health anomaly tripped rollback" in out
+    assert "diverging updates WERE applied" in out
+    assert "State is unpoisoned" not in out
+
+
+def test_rollback_give_up_flushes_flight_recorder(tmp_path):
+    """Every drilled fatal exit path must land a parseable flight
+    recorder whose ring shows the death's approach — here the
+    rollback-give-up (79) path: the last records are the NaN-poisoned
+    (bad) steps the guard kept skipping."""
+    from imagent_tpu.resilience import exitcodes
+    from imagent_tpu.telemetry.flightrec import read_flightrec
+
+    with pytest.raises(RuntimeError, match="persisted through"):
+        run(_cfg(tmp_path, save_model=False, epochs=50,
+                 faults="nan-grads:times=1000", max_bad_steps=2))
+    rec = read_flightrec(str(tmp_path / "tb" / "flightrec.0.json"))
+    assert rec is not None
+    assert rec["reason"] == "rollback-give-up"
+    assert rec["exit_code"] == exitcodes.ROLLBACK_GIVE_UP
+    assert rec["records"], "the ring must hold the final steps"
+    # Strict-JSON contract: the poisoned steps' NaN norms are nulled
+    # (json.dumps would otherwise emit bare NaN tokens).
+    bad = [r for r in rec["records"] if r["bad"]]
+    assert bad and all(r["grad_norm"] is None for r in bad)
+    assert "NaN" not in (tmp_path / "tb"
+                         / "flightrec.0.json").read_text()
+    assert rec["context"]["arch"] == "resnet18"
+
+
 def test_guard_counts_bad_steps_in_epoch_metrics(tmp_path):
     """A single transient NaN step (below --max-bad-steps) is skipped
     and surfaced in the epoch metrics, with no rollback."""

@@ -392,6 +392,12 @@ def test_tombstone_on_rollback_give_up(tmp_path):
     assert rec is not None and rec["reason"] == "rollback-give-up"
     assert rec["exit_code"] == exitcodes.ROLLBACK_GIVE_UP
     assert rec["retryable"] is False
+    # The flight recorder landed next to the tombstone that names it.
+    from imagent_tpu.telemetry.flightrec import read_flightrec
+    fr = read_flightrec(str(tmp_path / "tb" / "flightrec.0.json"))
+    assert fr is not None and fr["reason"] == "rollback-give-up"
+    assert fr["records"]
+    assert "flightrec=flightrec.0.json" in rec["detail"]
     # ...and a peer's monitor classifies it verbatim.
     m = DeadmanMonitor(heartbeat.heartbeat_dir(str(tmp_path / "tb")),
                        rank=1, world=2, deadline_secs=60.0,
@@ -434,6 +440,11 @@ def test_tombstone_on_unhandled_exception(tmp_path):
     assert rec is not None and rec["reason"] == "exception"
     assert rec["retryable"] is False
     assert "synthetic operator error" in rec["detail"]
+    from imagent_tpu.telemetry.flightrec import read_flightrec
+    fr = read_flightrec(str(tmp_path / "tb" / "flightrec.0.json"))
+    assert fr is not None and fr["reason"] == "exception"
+    assert fr["exit_code"] == exitcodes.FATAL_EXCEPTION
+    assert "flightrec=flightrec.0.json" in rec["detail"]
 
 
 def test_clean_finish_leaves_done_beat_and_no_tombstone(tmp_path):
@@ -480,6 +491,12 @@ def test_storage_outage_commit_fail_streak_exits_retryable(tmp_path):
     assert rec is not None and rec["reason"] == "storage-outage"
     assert rec["retryable"] is True
     assert exitcodes.is_retryable(rec["exit_code"])
+    # Storage for the LOG dir is distinct from the (dead) checkpoint
+    # dir in this drill, so the forensic record still lands.
+    from imagent_tpu.telemetry.flightrec import read_flightrec
+    fr = read_flightrec(str(tmp_path / "tb" / "flightrec.0.json"))
+    assert fr is not None and fr["reason"] == "storage-outage"
+    assert fr["exit_code"] == exitcodes.STORAGE_OUTAGE
 
 
 def test_storage_outage_unwritable_staging_retries_then_exits(
@@ -587,6 +604,14 @@ def test_deadman_pod_drill_kill_and_requeue(tmp_path):
     detect = float(re.search(r"detect_s=([0-9.]+)", out0).group(1))
     assert 2.0 <= detect <= 4.5, out0
     assert "emergency snapshot committed as LAST" in out0, out0
+    # The survivor's peer-death exit (87) landed its flight recorder
+    # with the last lagged health records before the pod degraded.
+    from imagent_tpu.telemetry.flightrec import read_flightrec
+    fr = read_flightrec(os.path.join(scratch, "tb",
+                                     "flightrec.0.json"))
+    assert fr is not None and fr["reason"] == "peer-dead", fr
+    assert fr["exit_code"] == exitcodes.PEER_DEAD
+    assert fr["records"], fr
 
     # Requeue: a fresh pod resumes from the emergency snapshot.
     outs2, rcs2 = _launch_deadman("resume", scratch)

@@ -354,3 +354,24 @@ def test_pod_telemetry_two_process_engine_run(tmp_path):
     # is positive on the straggling AND the healthy host.
     assert epochs[-1]["hosts"]["stats"]["compile_s"]["min"] >= 0.0
     assert epochs[-1]["counters"].get("quarantined", 0) == 0
+    # Model-health observability rode the same run: the epoch records
+    # carry warm EWMAs from the in-graph metric tail...
+    health = epochs[-1].get("health")
+    assert health is not None and health["ewma_n"] > 0, epochs[-1]
+    assert health["grad_norm_ewma"] > 0
+    # ...process 0 kept the live status surface current...
+    import subprocess
+    import sys as _sys
+    st = json.loads((tmp_path / "tb" / "status.json").read_text())
+    assert st["epoch"] == 1 and st["epochs"] == 2
+    assert (st.get("health") or {}).get("ewma_n", 0) > 0
+    # ...and the operator CLI renders the one-screen pod view from the
+    # real 2-process run's artifacts (status + heartbeats + jsonl).
+    proc = subprocess.run(
+        [_sys.executable, "-m", "imagent_tpu.status",
+         str(tmp_path / "tb")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "frontier: epoch 2/2" in proc.stdout, proc.stdout
+    assert "health: grad_norm ewma" in proc.stdout, proc.stdout
+    assert "goodput" in proc.stdout, proc.stdout
