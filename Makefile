@@ -4,8 +4,8 @@
 PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
 
-.PHONY: smoke test lint bench-smoke bench-anatomy drill-pod \
-	drill-divergence
+.PHONY: smoke test lint bench-smoke bench-anatomy bench-input \
+	drill-pod drill-divergence
 
 # Static-analysis gate (docs/STATIC_ANALYSIS.md): jaxlint — the
 # JAX/TPU-aware rules in imagent_tpu/analysis — over the package, the
@@ -27,6 +27,8 @@ lint:
 # async-checkpoint drills (incl. the 2-process mid-commit-kill
 # acceptance drill), and the core e2e train/resume smoke.
 smoke: lint
+	$(PY) benchmarks/input_pipeline.py --smoke \
+	    --out /tmp/BENCH_input_smoke.json
 	$(PYTEST) -m "not slow" tests/test_resilience.py \
 	    tests/test_fault_drills.py tests/test_ckpt_async.py \
 	    tests/test_e2e.py
@@ -64,6 +66,17 @@ drill-divergence:
 # before a real bench run.
 bench-smoke:
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/bench_smoke.py
+
+# Input-pipeline thread-scaling sweep (VERDICT item 7 / ROADMAP item
+# 5): decoder workers x batch x resolution through the real uint8-wire
+# path (decode -> worker IPC -> staging queue -> PrefetchStats), into
+# BENCH_input.json — the img/s/core curve + linearity knee recorded in
+# docs/ROOFLINE.md, and the sizing input for decode-offload hosts
+# (docs/OPERATIONS.md "Host CPU budget and decode offload"). Host-side
+# only (never imports jax); `--smoke` (a ~30s variant) gates `make
+# smoke` above.
+bench-input:
+	$(PY) benchmarks/input_pipeline.py
 
 # ConvNeXt-T per-stage block anatomy on the REAL chip, including the
 # fused-kernel columns (mlp_fused / block_fused) whose block-vs-fused

@@ -42,8 +42,22 @@ class Config:
     optimizer: str = "sgd"
     lr_decay_period: int = 30  # imagenet.py:158
     lr_decay_factor: float = 0.1  # imagenet.py:158
-    workers: int = 10  # imagenet.py:352
+    workers: int = 10  # imagenet.py:352 (0 = in-process serial decode)
     native_io: bool = True  # C++ threaded decode (imagent_tpu/native)
+    # Decode-offload endpoints, "host:port[,host:port...]" ("" = off):
+    # non-training CPU hosts running `python -m imagent_tpu.data.serve`
+    # decode this run's batches (same stream contract, shared-nothing)
+    # and ship ready uint8 batches over the wire to the staging queue
+    # (data/offload.py). A dead/unreachable service degrades to local
+    # decode with a counted fallback, never a dead run. imagefolder/tar
+    # datasets only.
+    decode_offload: str = ""
+    # Alert when an epoch's input-wait fraction (step-loop time blocked
+    # on the staging queue / epoch wall) exceeds this: master WARN +
+    # `input_wait_alert` telemetry event + status.json surface, with
+    # the slowest host named via the pod straggler flags (ROADMAP item
+    # 5's alerting clause). 0 disables.
+    input_wait_alert: float = 0.10
     log_dir: str = "runs/imagent_tpu"  # imagenet.py:363
     ckpt_dir: str = "checkpoints"  # imagenet.py:392 (file → dir for Orbax)
 
@@ -300,6 +314,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-native-io", dest="native_io", action="store_false",
                    default=True,
                    help="disable the C++ decode path (PIL fallback)")
+    p.add_argument("--decode-offload", type=str, default=c.decode_offload,
+                   metavar="HOST:PORT[,HOST:PORT...]",
+                   help="decode-offload service endpoints (python -m "
+                        "imagent_tpu.data.serve on non-training CPU "
+                        "hosts); falls back to local decode when "
+                        "unreachable")
+    p.add_argument("--input-wait-alert", type=float,
+                   default=c.input_wait_alert, metavar="FRACTION",
+                   help="WARN + telemetry event + status.json alert "
+                        "when an epoch's input-wait exceeds this "
+                        "fraction of epoch wall (default 0.10; 0 "
+                        "disables)")
     p.add_argument("--log-dir", type=str, default=c.log_dir)
     p.add_argument("--ckpt-dir", type=str, default=c.ckpt_dir)
     # New capabilities.

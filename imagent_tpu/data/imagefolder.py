@@ -23,14 +23,16 @@ memory), and the accelerator consumes via ``train.shard_batch``.
 from __future__ import annotations
 
 import os
+import time
 from typing import Iterator
 
 import numpy as np
 from PIL import Image
 
 from imagent_tpu.config import Config
+from imagent_tpu.data import stream
 from imagent_tpu.data.pipeline import (
-    PAD_ROW, Batch, iter_batch_rows, pad_batch, shard_indices, to_wire,
+    PAD_ROW, Batch, pad_batch, to_wire,
 )
 # Pure-Python module (no .so load at import): shared crop-parameter
 # derivation so both decode paths use identical fp32 constants.
@@ -217,6 +219,8 @@ class ImageFolderLoader:
         self._use_native = None  # resolved lazily in _ensure_pool
         self._warned_bad: set[str] = set()
         self._quarantined = 0  # unreadable files zero-filled this epoch
+        self._offload = None       # lazily-built OffloadClient
+        self._offload_fallbacks = 0  # batches decoded locally instead
 
     @property
     def quarantined(self) -> int:
@@ -226,18 +230,30 @@ class ImageFolderLoader:
         whose shard rots quarantines more AND decodes slower)."""
         return self._quarantined
 
-    def _ensure_pool(self):
+    @property
+    def offload_fallbacks(self) -> int:
+        """Batches decoded locally because the decode-offload service
+        was down/unreachable during the most recent epoch (reset at
+        each ``epoch()`` start) — 0 when offload is off or healthy;
+        surfaced per epoch like ``quarantined`` so a dead offload host
+        is a visible counter, never a silent slowdown."""
+        return self._offload_fallbacks
+
+    def _resolve_native(self) -> bool:
+        """Which decode path this host actually runs (resolved once;
+        no pool spawn — cheap enough for the offload fingerprint)."""
         if self._use_native is None:
             if self.cfg.native_io:
                 from imagent_tpu import native
                 self._use_native = native.available()
             else:
                 self._use_native = False
-            if self._use_native:
-                # Fallback decoder (corrupt/odd files) runs in-process.
-                _init_worker(self.cfg.image_size)
-                return
-        if self._use_native:
+        return self._use_native
+
+    def _ensure_pool(self):
+        if self._resolve_native():
+            # Fallback decoder (corrupt/odd files) runs in-process.
+            _init_worker(self.cfg.image_size)
             return
         if self._pool is None and self.cfg.workers > 0:
             import multiprocessing as mp
@@ -327,37 +343,118 @@ class ImageFolderLoader:
                 + np.uint64(epoch) * np.uint64(0x1_0000_0000)
                 + np.uint64(self.cfg.seed) * np.uint64(0x1000_0000_0000))
 
-    def _decode_batch(self, rows: np.ndarray, epoch: int) -> Batch:
-        valid = rows[rows != PAD_ROW]
+    def _decode_rows(self, valid: np.ndarray,
+                     epoch: int) -> np.ndarray:
+        """LOCAL decode of dataset rows → uint8 (N, S, S, 3) — the
+        shared decode body behind both the in-process path and the
+        offload service (``data/serve.py`` calls this on the decode
+        host). The ``decode.slow`` fault point models a CPU-starved /
+        thermally-throttled decode host (one sleep per batch) for the
+        offload drills — it fires on the LOCAL path only, so a healthy
+        offload service visibly rescues an input-bound training host."""
+        f = faultinject.fire("decode.slow")
+        if f is not None:
+            time.sleep(float(f.get("secs", 0.2)))
+        self._ensure_pool()
+        return self._local_decode(valid, epoch)
+
+    def _local_decode(self, valid: np.ndarray,
+                      epoch: int) -> np.ndarray:
+        """Loader-specific decode body (tarshards overrides: staged
+        ranged reads instead of loose files)."""
         paths = [self.paths[i] for i in valid]
         seeds = self._aug_seeds(valid, epoch)
         if self._use_native:
-            images = self._decode_native(paths, seeds)
-        else:
-            images = self._decode_pil_batch(paths, seeds)
+            return self._decode_native(paths, seeds)
+        return self._decode_pil_batch(paths, seeds)
+
+    def _ensure_offload(self):
+        if self._offload is None and self.cfg.decode_offload:
+            from imagent_tpu.data.offload import OffloadClient
+            self._offload = OffloadClient(
+                self.cfg.decode_offload, fingerprint=self.fingerprint())
+        return self._offload
+
+    def fingerprint(self) -> dict:
+        """What the offload handshake must agree on for the decoded
+        bytes to be the ones this run would have produced locally:
+        decode geometry + the augmentation-stream key + dataset size
+        (a cheap stand-in for dataset identity) + the DECODE PATH —
+        native and PIL round the last ULP differently (±1 uint8/pixel,
+        pinned in tests/test_native_io.py), so a decode box whose
+        native build silently failed must be refused, not trusted to
+        be byte-identical."""
+        return {"dataset": type(self).__name__, "split": self.split,
+                "num_examples": int(self.num_examples),
+                "image_size": int(self.cfg.image_size),
+                "seed": int(self.cfg.seed),
+                "augment": bool(self.train and self.cfg.augment),
+                "decode": ("native" if self._resolve_native()
+                           else "pil")}
+
+    def _decode_batch(self, rows: np.ndarray, epoch: int,
+                      step: int = 0) -> Batch:
+        valid = rows[rows != PAD_ROW]
+        stream.trace_rows(self.process_index, self.split, epoch, step,
+                          valid)
+        images = None
+        client = self._ensure_offload()
+        if client is not None:
+            # expect_labels: every offload batch is cross-checked
+            # against the local dataset scan — a decode host pointed
+            # at a different dataset of the same size fails the first
+            # batch loudly instead of training on wrong pixels.
+            images, q = client.decode(
+                valid, epoch,
+                expect_labels=self.labels[valid].astype(np.int32))
+            self._quarantined += q
+            if images is None:
+                # Service down/unreachable past its retry budget:
+                # degrade to local decode — one counter and a
+                # (rate-limited, client-side) warning, never a dead
+                # run. The client keeps probing, so a restarted
+                # service re-attaches mid-epoch.
+                self._offload_fallbacks += 1
+        if images is None:
+            images = self._decode_rows(valid, epoch)
         labels = self.labels[valid].astype(np.int32)
         return pad_batch(to_wire(images, self.cfg.transfer_dtype),
                          labels, self.local_rows)
 
-    def epoch(self, epoch: int) -> Iterator[Batch]:
+    def _stream_key(self) -> stream.StreamKey:
+        """The seed-and-position key this loader's sample order is a
+        pure function of (``data/stream.py`` contract)."""
+        return stream.StreamKey(
+            num_examples=self.num_examples,
+            global_batch=self.global_batch, seed=self.cfg.seed,
+            process_index=self.process_index,
+            process_count=self.process_count, shuffle=self.train,
+            drop_remainder=self.train)
+
+    def epoch(self, epoch: int, start_step: int = 0,
+              stats=None) -> Iterator[Batch]:
         """Yields host-local batches; decode of batch k+1 overlaps the
-        device's consumption of batch k via a bounded prefetch queue."""
-        self._ensure_pool()
+        device's consumption of batch k via a bounded prefetch queue.
+
+        ``start_step`` opens the deterministic sample stream at
+        ``(epoch, start_step)`` — the skipped prefix is never decoded
+        (mid-epoch ``--resume``). ``stats``: an optional
+        ``PrefetchStats`` accumulating the consumer's staging-queue
+        wait (the input-pipeline bench reads the host-batch stage
+        through it)."""
         self._quarantined = 0
-        idx = shard_indices(
-            self.num_examples, epoch, self.cfg.seed, self.process_index,
-            self.process_count, shuffle=self.train,
-            drop_remainder=self.train, global_batch=self.global_batch)
-        chunks = list(iter_batch_rows(idx, self.local_rows))
+        self._offload_fallbacks = 0
+        chunks = list(stream.open_stream(self._stream_key(), epoch,
+                                         start_step))
 
         def produce(put):
-            for rows in chunks:
-                if not put(self._decode_batch(rows, epoch)):
+            for step, rows in chunks:
+                if not put(self._decode_batch(rows, epoch, step)):
                     return
 
         # Shared cancellable producer/consumer protocol (prefetch.py):
         # unwinds the decode thread deterministically on early exit.
-        yield from iter_with_producer(produce, maxsize=4)
+        yield from iter_with_producer(produce, maxsize=4, stats=stats)
         if self._quarantined:
             # Surfaced per epoch, not hidden: N zero-filled samples per
             # epoch is a data-quality signal the operator must see.
@@ -369,3 +466,6 @@ class ImageFolderLoader:
         if self._pool is not None:
             self._pool.terminate()
             self._pool = None
+        if self._offload is not None:
+            self._offload.close()
+            self._offload = None

@@ -27,6 +27,13 @@ from typing import Iterator, Protocol
 import numpy as np
 
 from imagent_tpu.config import Config
+# Canonical sample-order contract (seed-and-position-keyed): ONE
+# implementation, shared by every loader, the engine's mid-epoch
+# resume, and the decode-offload service. Re-exported here so the
+# pre-stream import sites keep working.
+from imagent_tpu.data.stream import (  # noqa: F401
+    PAD_ROW, StreamKey, iter_batch_rows, open_stream, shard_indices,
+)
 
 
 @dataclasses.dataclass
@@ -59,10 +66,14 @@ class Loader(Protocol):
     steps_per_epoch: int
     num_examples: int
 
-    def epoch(self, epoch: int) -> Iterator[Batch]: ...
+    def epoch(self, epoch: int,
+              start_step: int = 0) -> Iterator[Batch]:
+        """Batches of one epoch from ``start_step`` on — opening the
+        deterministic sample stream at ``(epoch, step)`` per
+        ``data/stream.py``: a mid-epoch resume decodes NOTHING of the
+        already-trained prefix and replays/skips no sample."""
+        ...
 
-
-PAD_ROW = -1  # sentinel: padded slot, contributes mask 0
 
 WIRE_DTYPES = ("uint8", "bf16", "float32")
 
@@ -83,39 +94,6 @@ def to_wire(images_u8: np.ndarray, transfer_dtype: str) -> np.ndarray:
         return images_u8.astype(np.float32)
     raise ValueError(f"unknown --transfer-dtype {transfer_dtype!r}; "
                      f"one of {'|'.join(WIRE_DTYPES)}")
-
-
-def shard_indices(n: int, epoch: int, seed: int, process_index: int,
-                  process_count: int, shuffle: bool,
-                  drop_remainder: bool, global_batch: int) -> np.ndarray:
-    """Pure sharding logic (unit-testable): which dataset rows this host
-    reads this epoch. Mirrors ``DistributedSampler`` + ``set_epoch``.
-
-    Every process receives the SAME number of slots (SPMD requirement:
-    unequal per-host batch counts would deadlock the collective in the
-    eval step — the invariant DistributedSampler keeps by padding).
-    Train drops the global remainder; eval pads with ``PAD_ROW`` sentinels
-    which become masked samples.
-    """
-    order = (np.random.default_rng(seed + epoch).permutation(n)
-             if shuffle else np.arange(n))
-    if drop_remainder:
-        usable = (n // global_batch) * global_batch
-        order = order[:usable]
-    else:
-        padded = -(-n // global_batch) * global_batch
-        order = np.concatenate(
-            [order, np.full(padded - n, PAD_ROW, np.int64)])
-    return order[process_index::process_count]
-
-
-def iter_batch_rows(idx: np.ndarray, local_rows: int):
-    """Split a host's slot array into per-batch row arrays. With
-    ``shard_indices`` output, every host yields the same batch count."""
-    for start in range(0, len(idx), local_rows):
-        rows = idx[start:start + local_rows]
-        if len(rows) == local_rows:
-            yield rows
 
 
 def pad_batch(images: np.ndarray, labels: np.ndarray,

@@ -32,7 +32,14 @@ import threading
 import time
 from typing import Callable, Iterator
 
-from imagent_tpu.train import shard_batch
+# NOTE: no top-level jax/train import. The device-staging half of this
+# module (``_stage_batch`` → ``train.shard_batch``) imports lazily:
+# the host-only half (``PrefetchStats``/``iter_with_producer``) is on
+# the import path of every spawned decode-pool worker (spawn context
+# re-imports ``data/imagefolder.py`` in a fresh interpreter) and of the
+# decode-offload service (``data/serve.py``) — pulling jax there costs
+# seconds of startup and a device registry nothing uses (asserted
+# jax-free-by-import in tests/test_stream.py).
 
 
 class PrefetchStats:
@@ -130,6 +137,7 @@ def iter_with_producer(produce: Callable, maxsize: int,
 def _stage_batch(mesh, batch, with_mask: bool,
                  stats: PrefetchStats | None):
     """One ``data.pipeline.Batch`` → global device arrays (+ stats)."""
+    from imagent_tpu.train import shard_batch
     if stats is not None:
         stats.bytes_staged += (
             batch.images.nbytes + batch.labels.nbytes

@@ -1,0 +1,78 @@
+"""``python -m imagent_tpu.data.serve`` — run a decode-offload host.
+
+Point any plain CPU box (no accelerator stack needed; this import
+chain is jax-free, asserted by tests/test_stream.py) at the same
+dataset the training pod reads and it becomes decode capacity:
+
+    python -m imagent_tpu.data.serve \\
+        --data-root /data/imagenet --dataset tar \\
+        --image-size 448 --seed 0 --augment --workers 16 --port 7707
+
+Training hosts attach with ``--decode-offload host:7707[,host2:7707]``.
+The flags that shape the decoded bytes (``--image-size --seed
+--augment --dataset --data-root`` and the dataset's size) must match
+the training run — the hello handshake refuses a mismatch, and the
+trainer cross-checks every batch's labels against its own scan
+(docs/OPERATIONS.md "Host CPU budget and decode offload").
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m imagent_tpu.data.serve",
+        description="Decode-offload service: decode this dataset's "
+                    "batches for training hosts (data/offload.py wire)")
+    p.add_argument("--data-root", required=True)
+    p.add_argument("--dataset", default="imagefolder",
+                   choices=["imagefolder", "tar"],
+                   help="synthetic needs no decode, hence no offload")
+    p.add_argument("--image-size", type=int, default=448)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--augment", action="store_true", default=False,
+                   help="must match the training run's --augment")
+    p.add_argument("--workers", type=int, default=os.cpu_count() or 1,
+                   help="decode workers/threads on THIS host "
+                        "(default: all cores — the whole point of a "
+                        "dedicated decode box)")
+    p.add_argument("--no-native-io", dest="native_io",
+                   action="store_false", default=True)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7707,
+                   help="0 = pick a free port (printed on the READY "
+                        "line)")
+    p.add_argument("--die-after-requests", type=int, default=0,
+                   help=argparse.SUPPRESS)  # drill hook (tests)
+    ns = p.parse_args(argv)
+    if ns.workers < 0:
+        print("--workers must be >= 0", file=sys.stderr)
+        return 2
+
+    from imagent_tpu.config import Config
+    from imagent_tpu.data.offload import DecodeServer
+
+    cfg = Config(data_root=ns.data_root, dataset=ns.dataset,
+                 image_size=ns.image_size, seed=ns.seed,
+                 augment=ns.augment, workers=ns.workers,
+                 native_io=ns.native_io)
+    srv = DecodeServer(cfg, host=ns.host, port=ns.port,
+                       die_after_requests=ns.die_after_requests)
+    print(f"SERVE READY port={srv.port} pid={os.getpid()} "
+          f"dataset={ns.dataset} root={ns.data_root} "
+          f"size={ns.image_size} workers={ns.workers}", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

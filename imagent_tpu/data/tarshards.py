@@ -127,6 +127,8 @@ class TarShardLoader(ImageFolderLoader):
         self._use_native = None
         self._warned_bad: set[str] = set()
         self._quarantined = 0
+        self._offload = None
+        self._offload_fallbacks = 0
         shm = "/dev/shm"
         self._staging = tempfile.mkdtemp(
             prefix="imagent_tar_",
@@ -171,13 +173,15 @@ class TarShardLoader(ImageFolderLoader):
             staged[int(r)] = path
         return [staged[int(r)] for r in rows]
 
-    def _decode_batch(self, rows, epoch):
-        from imagent_tpu.data.pipeline import PAD_ROW, pad_batch, to_wire
-
-        valid = rows[rows != PAD_ROW]
+    def _local_decode(self, valid, epoch):
+        """Stage the batch's tar-shard ranges then decode — the body
+        behind both the in-process path and (via the shared
+        ``_decode_rows``) the decode-offload service, which runs it on
+        a non-training CPU host against its own copy/mount of the
+        shards (shared-nothing: rows → bytes is pure given the
+        stream key)."""
         staged = self._stage_rows(valid)
         seeds = self._aug_seeds(valid, epoch)
-        self._ensure_pool()
         # Quarantine warnings/dedup key on the real member name, not the
         # throwaway /dev/shm staging uuid.
         member_names = [str(self._names[int(r)]) for r in valid]
@@ -194,9 +198,7 @@ class TarShardLoader(ImageFolderLoader):
                     os.unlink(p)
                 except OSError:
                     pass
-        labels = self.labels[valid].astype(np.int32)
-        return pad_batch(to_wire(images, self.cfg.transfer_dtype),
-                         labels, self.local_rows)
+        return images
 
     def close(self):
         super().close()

@@ -238,19 +238,6 @@ def _stop_agreed(stop_check, step_i: int) -> bool:
     return bool(multihost_utils.process_allgather(flag).max())
 
 
-def _skip_batches(it, n: int):
-    """Skip the first ``n`` items, forwarding close() to the source so
-    early generator exit still unwinds the loader's producer thread."""
-    try:
-        for i, item in enumerate(it):
-            if i >= n:
-                yield item
-    finally:
-        close = getattr(it, "close", None)
-        if close is not None:
-            close()
-
-
 def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                     loader, epoch: int, lr: float, is_master: bool,
                     stop_check=None, start_step: int = 0,
@@ -334,13 +321,12 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
         assert start_step == 0, "warm prefetch cannot skip batches"
         prefetch_iter = prefetch
     else:
-        it = loader.epoch(epoch)
-        if start_step:
-            # NOT itertools.islice: islice has no close(), which would
-            # sever the prefetcher's deterministic unwind of the
-            # loader's decode thread exactly on the
-            # resumed-then-interrupted-again path.
-            it = _skip_batches(it, start_step)
+        # The loader opens its deterministic sample stream AT
+        # (epoch, start_step) (data/stream.py): a mid-epoch resume
+        # never decodes the already-trained prefix — the old
+        # skip-and-discard path paid start_step full batch decodes
+        # just to throw them away.
+        it = loader.epoch(epoch, start_step=start_step)
         prefetch_iter = Prefetcher(mesh, it, depth=cfg.prefetch_depth)
     stats = prefetch_iter.stats
     if watchdog is not None:
@@ -489,6 +475,11 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
         telem.absorb_input(stats)
         telem.count("quarantined",
                     int(getattr(loader, "quarantined", 0) or 0))
+        # Batches the decode-offload service missed (down/unreachable)
+        # and local decode carried instead — a dying offload host is a
+        # counter + warning, never a silent throughput cliff.
+        telem.count("offload_fallbacks",
+                    int(getattr(loader, "offload_fallbacks", 0) or 0))
     # Data-starvation counters (data/prefetch.py::PrefetchStats): how
     # long the step loop sat blocked on the staging queue, and the wire
     # bytes that crossed host→device — input-boundness diagnosable from
@@ -533,8 +524,13 @@ def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
     if telem is not None:
         # The eval epoch is one `eval` phase to the goodput accountant
         # (attributed by the caller); its internal input-wait rides the
-        # counters so an input-bound VAL path is still visible.
-        telem.count("eval_input_wait_s", stats.wait_s)
+        # eval-side counters — strictly partitioned from the train
+        # `input_wait` phase and its alert threshold. The val loader
+        # runs the same offload client (split="val"): its fallbacks
+        # must surface too, not just the train loader's.
+        telem.absorb_eval_input(stats)
+        telem.count("eval_offload_fallbacks",
+                    int(getattr(loader, "offload_fallbacks", 0) or 0))
     return metrics, time.time() - t0
 
 
@@ -898,6 +894,23 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
             f"got {cfg.transfer_dtype!r}")
     if cfg.prefetch_depth < 1:
         raise ValueError("--prefetch-depth must be >= 1")
+    if cfg.workers < 0:
+        raise ValueError(
+            f"--workers must be >= 0 (0 = in-process serial decode; "
+            f"got {cfg.workers}) — the contract every loader honors "
+            "(data/pipeline.py)")
+    if not 0.0 <= cfg.input_wait_alert <= 1.0:
+        raise ValueError("--input-wait-alert is a fraction of epoch "
+                         f"wall in [0, 1] (0 disables), got "
+                         f"{cfg.input_wait_alert}")
+    if cfg.decode_offload:
+        if cfg.dataset == "synthetic":
+            raise ValueError("--decode-offload applies to the "
+                             "imagefolder/tar datasets (synthetic "
+                             "generates in-process; nothing to "
+                             "offload)")
+        from imagent_tpu.data.offload import parse_endpoints
+        parse_endpoints(cfg.decode_offload)  # loud on typos, pre-pod
     if cfg.profile and cfg.profile_at_step:
         raise ValueError("--profile and --profile-at-step are mutually "
                          "exclusive: both drive jax.profiler traces "
@@ -1378,6 +1391,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
     })
 
     anomaly_hwm = [0]  # monitor.anomalies already attributed to epochs
+    last_input_alert = [None]  # newest epoch's input-wait alert (if any)
 
     def _end_telemetry_epoch(ep: int, tm: dict,
                              interrupted: bool = False,
@@ -1407,6 +1421,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
             telem.gauge("hb_peer_staleness_s",
                         round(pod.max_peer_staleness(), 3))
         record = telem.epoch_end(ep, tm, interrupted=interrupted)
+        last_input_alert[0] = (record or {}).get("input_wait_alert")
         if status is not None:
             # Epoch-boundary status write: covers --log-every 0 runs
             # and adds the goodput the in-epoch writes can't know yet.
@@ -1422,6 +1437,9 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                 "best_top1": best_top1,
                 "bad_steps": tm.get("bad_steps", 0),
                 "goodput": (record or {}).get("goodput"),
+                # The input-bound alert (when tripped): the status CLI
+                # renders it so a starving pod is visible at a glance.
+                "input_wait_alert": last_input_alert[0],
                 "degraded": bool(pod is not None and pod.degraded),
                 "interrupted": bool(interrupted),
                 "health": (monitor.snapshot()
@@ -1760,6 +1778,10 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                      else train_loader.steps_per_epoch),
             "steps_per_epoch": train_loader.steps_per_epoch,
             "loss": train_m.get("loss"), "best_top1": best_top1,
+            # Carried into the terminal record: a run that FINISHED
+            # input-bound should say so on its last status surface,
+            # not only in the per-epoch telemetry log.
+            "input_wait_alert": last_input_alert[0],
             "degraded": bool(pod is not None and pod.degraded),
             "health": (monitor.snapshot()
                        if monitor is not None else None),

@@ -1,3 +1,3 @@
 from imagent_tpu.native.loader import (  # noqa: F401
-    available, decode_batch_uint8, decode_resize_batch,
+    available, decode_batch_uint8, decode_resize_batch, has_webp,
 )

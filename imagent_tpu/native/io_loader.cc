@@ -23,7 +23,9 @@
 // jpeglib.h requires stdio/stddef types to be declared before inclusion.
 #include <jpeglib.h>
 #include <png.h>
+#ifndef IL_NO_WEBP
 #include <webp/decode.h>
+#endif
 
 #include <algorithm>
 #include <atomic>
@@ -248,7 +250,15 @@ bool decode_png(const char* path, std::vector<uint8_t>* pix, int* w, int* h) {
 }
 
 // WebP via libwebp. Reads the whole file (webp has no streaming-decode
-// need at dataset-image sizes).
+// need at dataset-image sizes). Optional: built with -DIL_NO_WEBP when
+// the libwebp headers are absent (imagent_tpu/native/loader.py retries
+// the build without it) — webp members then fall to the per-file PIL
+// rescue instead of costing the whole native path.
+#ifdef IL_NO_WEBP
+bool decode_webp(const char*, std::vector<uint8_t>*, int*, int*) {
+  return false;  // unsupported in this build; PIL rescue handles it
+}
+#else
 bool decode_webp(const char* path, std::vector<uint8_t>* pix, int* w,
                  int* h) {
   FILE* f = fopen(path, "rb");
@@ -271,6 +281,7 @@ bool decode_webp(const char* path, std::vector<uint8_t>* pix, int* w,
   *h = hh;
   return true;
 }
+#endif  // IL_NO_WEBP
 
 // Minimal BMP decoder: uncompressed (BI_RGB) 24/32-bit, the overwhelmingly
 // common case for dataset BMPs; anything else falls to the PIL rescue.
@@ -546,5 +557,16 @@ void il_sample_crop(int w, int h, const float* aug_params, uint64_t seed,
 }
 
 int il_version() { return 4; }
+
+// Which optional decoders this BUILD carries (a capability probe, not
+// an ABI change: absent in pre-probe binaries, where webp was always
+// compiled in — the Python side treats a missing symbol as "has it").
+int il_has_webp() {
+#ifdef IL_NO_WEBP
+  return 0;
+#else
+  return 1;
+#endif
+}
 
 }  // extern "C"

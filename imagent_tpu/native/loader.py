@@ -50,18 +50,24 @@ def _build() -> bool:
     # the Python port (two roundings per p*f+c, never fused) — GCC's
     # default contraction would emit fma on targets that have it and
     # silently break cross-path augmentation parity.
-    cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-ffp-contract=off",
-           "-shared", "-o", tmp, _SRC, "-ljpeg", "-lpng", "-lwebp"]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB)
-        return True
-    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+    base = ["g++", "-O3", "-fPIC", "-std=c++17", "-ffp-contract=off",
+            "-shared", "-o", tmp, _SRC, "-ljpeg", "-lpng"]
+    # libwebp is optional: hosts without its headers (common on lean
+    # CPU decode boxes) still get the native jpeg/png fast path — webp
+    # members fall to the per-file PIL rescue in that build.
+    for cmd in (base + ["-lwebp"], base + ["-DIL_NO_WEBP"]):
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return False
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+            os.replace(tmp, _LIB)
+            return True
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            continue
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
+    return False
 
 
 def _load() -> ctypes.CDLL | None:
@@ -108,6 +114,22 @@ def _load() -> ctypes.CDLL | None:
 def available() -> bool:
     """True once the native library is built and loadable."""
     return _load() is not None
+
+
+def has_webp() -> bool:
+    """Whether this build decodes webp natively (libwebp present at
+    build time). Without it, webp members fall to the per-file PIL
+    rescue — correct, just slower for webp-heavy datasets."""
+    lib = _load()
+    if lib is None:
+        return False
+    try:
+        fn = lib.il_has_webp
+    except AttributeError:
+        return True  # pre-probe builds always linked libwebp
+    fn.restype = ctypes.c_int
+    fn.argtypes = []
+    return bool(fn())
 
 
 DEFAULT_AUG = (0.08, 1.0, 3.0 / 4.0, 4.0 / 3.0, 0.5)

@@ -188,6 +188,15 @@ def render(run_dir: str, now: float | None = None) -> str:
                 f"loss ewma {_fmt(h.get('loss_ewma'))} | anomalies "
                 f"{h.get('anomalies', 0)} | bad steps "
                 f"{h.get('bad_steps', 0)}")
+        iw = st.get("input_wait_alert")
+        if iw:
+            lines.append(
+                f"INPUT-BOUND: input_wait "
+                f"{_fmt(iw.get('fraction'), '.0%')} of epoch wall "
+                f"(alert at {_fmt(iw.get('threshold'), '.0%')}, "
+                f"streak {iw.get('streak', 1)}) — host "
+                f"{iw.get('worst_host', '?')} slowest "
+                f"({_fmt(iw.get('worst_host_wait_s'), '.1f')}s)")
     if epoch_rec is not None:
         phases = epoch_rec.get("phases") or {}
         lines.append(

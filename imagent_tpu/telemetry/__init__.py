@@ -67,6 +67,12 @@ class TelemetrySession:
         self.logger = logger
         self.straggler_factor = float(
             getattr(cfg, "straggler_factor", 2.0))
+        # --input-wait-alert: an epoch whose input-wait fraction of
+        # wall exceeds this gets a WARN + event + status surface
+        # (0 = off). Streak counts consecutive offending epochs.
+        self.input_wait_alert = float(
+            getattr(cfg, "input_wait_alert", 0.0))
+        self._alert_streak = 0
         self.acct = GoodputAccountant()
         self.sampler = StepTimeSampler()
         self.writer = (TelemetryWriter(cfg.log_dir)
@@ -188,6 +194,20 @@ class TelemetrySession:
             self._max_wait_s = max(self._max_wait_s,
                                    getattr(stats, "max_wait_s", 0.0))
 
+    def absorb_eval_input(self, stats) -> None:
+        """Fold an EVAL epoch's ``PrefetchStats`` — strictly partitioned
+        from the train-side ``absorb_input``: eval wait rides the
+        ``eval_input_wait_s``/``eval_h2d_mb`` counters (inside the
+        ``eval`` goodput phase), NEVER the ``input_wait`` phase or the
+        ``data/host_blocked_s`` TB series, whose alerting threshold
+        (`--input-wait-alert`) must judge the train step loop alone.
+        The partition is regression-tested (tests/test_telemetry.py::
+        test_eval_input_partitioned_from_train + the offload drill in
+        tests/test_offload.py)."""
+        if self.enabled and self._in_epoch:
+            self.count("eval_input_wait_s", stats.wait_s)
+            self.count("eval_h2d_mb", float(stats.bytes_staged) / 1e6)
+
     # ---- epoch close (the one collective) -------------------------------
 
     def epoch_end(self, epoch: int, train_m: dict | None = None,
@@ -233,7 +253,50 @@ class TelemetrySession:
         }
         if self.health is not None:
             record["health"] = self.health.snapshot()
+        # Input-wait alerting (ROADMAP item 5's alerting clause): the
+        # fraction is an epoch-long average, so one offending epoch IS
+        # sustained starvation, not a burst; the streak counts how long
+        # it has persisted. The pod straggler flags name the slow host
+        # when ONE host is dragging (vs a pod-wide storage/offload
+        # shortfall, where the flags stay empty and every host waits).
+        alert = None
+        if self.input_wait_alert > 0 and wall > 0:
+            frac = phases["input_wait"] / wall
+            if frac > self.input_wait_alert:
+                self._alert_streak += 1
+                col = matrix[:, HOST_FIELDS.index("input_wait_s")]
+                worst = int(col.argmax())
+                alert = {
+                    "epoch": int(epoch),
+                    "fraction": round(frac, 4),
+                    "threshold": self.input_wait_alert,
+                    "streak": self._alert_streak,
+                    "wall_s": round(wall, 3),
+                    "worst_host": worst,
+                    "worst_host_wait_s": round(float(col[worst]), 3),
+                    "stragglers": [
+                        s for s in record["stragglers"]
+                        if s["metric"] == "input_wait_s"],
+                }
+                record["input_wait_alert"] = alert
+            else:
+                self._alert_streak = 0
+        if alert is not None and self.writer is not None:
+            self.writer.write("input_wait_alert", alert)
         if self.is_master:
+            if alert is not None:
+                who = (f"host {alert['worst_host']} slowest "
+                       f"({alert['worst_host_wait_s']}s)"
+                       if record["hosts"]["count"] > 1 else
+                       f"{alert['worst_host_wait_s']}s blocked")
+                print(f"WARNING: INPUT-BOUND epoch {epoch + 1}: "
+                      f"input_wait {alert['fraction']:.0%} of epoch "
+                      f"wall (alert at "
+                      f"{self.input_wait_alert:.0%}, streak "
+                      f"{alert['streak']}) — {who}. Raise --workers, "
+                      "add decode-offload hosts, or check storage "
+                      "(docs/OPERATIONS.md 'Host CPU budget and "
+                      "decode offload')", flush=True)
             if record["stragglers"]:
                 names = ", ".join(
                     f"host {s['host']} {s['metric']} {s['value']} "

@@ -66,10 +66,15 @@ class TrainLogger:
             self.writer.add_scalar("data/h2d_mb",
                                    train["h2d_bytes"] / 1e6, epoch)
         if val is not None and "host_blocked_s" in val:
-            # Val often reads a different storage path — its own series.
-            self.writer.add_scalar("data/val_host_blocked_s",
+            # Eval reads its own (often different) storage path and
+            # must NOT pollute the train series `data/host_blocked_s`
+            # that the --input-wait-alert threshold and the thread-
+            # scaling budget (docs/ROOFLINE.md) are judged against —
+            # the split is regression-tested (tests/test_telemetry.py
+            # and the offload drill in tests/test_offload.py).
+            self.writer.add_scalar("data/eval_blocked_s",
                                    val["host_blocked_s"], epoch)
-            self.writer.add_scalar("data/val_h2d_mb",
+            self.writer.add_scalar("data/eval_h2d_mb",
                                    val["h2d_bytes"] / 1e6, epoch)
         self.writer.flush()
 

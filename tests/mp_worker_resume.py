@@ -1,0 +1,99 @@
+"""Mid-epoch-resume determinism drill worker (2 OS processes), two
+phases via ``IMAGENT_RESUME_PHASE``:
+
+``kill``: both ranks form a real 2-process mesh and train epoch 0 with
+the sample trace armed (``IMAGENT_SAMPLE_TRACE``). Rank 0's ``sigterm``
+fault fires at step 4; the preemption any-reduce lands the agreed stop
+at the step-8 boundary, the pod checkpoints LAST with
+``resume_step=8`` mid-epoch, and both ranks exit cleanly (the PR 7
+salvage-meta contract, driven by the registered fault, no external
+killer).
+
+``resume``: a fresh 2-process pod ``--resume``s. The loader must open
+the deterministic sample stream AT ``(epoch 0, step 8)`` — decoding
+nothing of the already-trained prefix — and complete the run.
+
+The parent test concatenates the two phases' per-rank sample traces
+(kill truncated to the checkpoint's ``resume_step``) and asserts
+byte-identical equality with the uninterrupted stream contract
+(``data/stream.py::open_stream``) — no sample replayed, none skipped,
+per rank. ``IMAGENT_RESUME_DATASET`` selects synthetic or imagefolder
+(the parent builds the image tree).
+
+Usage: python mp_worker_resume.py <rank> <port> <world>  (scratch via
+IMAGENT_MP_SCRATCH).
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    scratch = os.environ["IMAGENT_MP_SCRATCH"]
+    phase = os.environ.get("IMAGENT_RESUME_PHASE", "kill")
+    dataset = os.environ.get("IMAGENT_RESUME_DATASET", "synthetic")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2")
+    os.environ.update({
+        "SLURM_JOB_NUM_NODES": "2",
+        "SLURM_NODEID": str(rank),
+        "SLURM_LOCALID": "0",
+        "SLURM_PROCID": str(rank),
+        "SLURM_NTASKS": "2",
+        "SLURM_JOB_NODELIST": "127.0.0.1",
+        "IMAGENT_COORDINATOR_PORT": str(port),
+    })
+    # Per-phase trace files: the parent concatenates kill[:resume_step]
+    # + resume and compares to the pure stream contract.
+    os.environ["IMAGENT_SAMPLE_TRACE"] = os.path.join(
+        scratch, f"trace_{phase}")
+    if phase == "kill" and rank == 0:
+        # Cloud-TPU-style single-host preemption notice: only rank 0
+        # gets the signal; the any-reduce must stop the whole pod at
+        # the same step boundary (step 8, the first multiple of 8
+        # after the fault).
+        os.environ["IMAGENT_FAULTS"] = "sigterm:after=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from imagent_tpu.config import Config
+    from imagent_tpu.engine import run
+
+    # 2 procs x 2 fake devices -> global batch 16; 256 samples -> 16
+    # steps/epoch (the agreed stop at step 8 is genuinely mid-epoch).
+    data_kw = (dict(dataset="synthetic", synthetic_size=256)
+               if dataset == "synthetic" else
+               dict(dataset="imagefolder",
+                    data_root=os.path.join(scratch, "data"),
+                    augment=True))
+    cfg = Config(arch="resnet18", image_size=16, num_classes=2,
+                 batch_size=4, epochs=1, lr=0.05, workers=0,
+                 bf16=False, log_every=0, seed=0, save_model=True,
+                 backend="cpu", eval_every=1,
+                 resume=(phase == "resume"),
+                 log_dir=os.path.join(scratch, "tb"),
+                 ckpt_dir=os.path.join(scratch, "ck"), **data_kw)
+
+    result = run(cfg)
+    if phase == "kill":
+        assert result["preempted"] is True, result
+        meta_path = os.path.join(scratch, "ck", "last_meta.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        assert meta["epoch"] == -1, meta  # epoch 0 interrupted
+        print(f"KILL_OK rank={rank} "
+              f"resume_step={int(meta['resume_step'])}", flush=True)
+    else:
+        assert result["preempted"] is False, result
+        assert result["final_train"]["n"] > 0, result
+        print(f"RESUME_OK rank={rank}", flush=True)
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

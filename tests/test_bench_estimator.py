@@ -75,3 +75,25 @@ def test_robust_samples_persistent_noise_reported_not_hidden():
     assert len(samples) == 4
     assert _spread_pct(samples) > 8.0
     assert rejected == 8  # every sample of rounds 1-2 was out of band
+
+
+def test_input_pipeline_knee_stops_at_first_dip():
+    """benchmarks/input_pipeline.find_knee: a later worker count that
+    pops back above the bar (noise) must not certify linearity across
+    a region that measurably broke it."""
+    from benchmarks.input_pipeline import find_knee
+
+    def cell(w, per_core):
+        return {"workers": w, "img_s": per_core * w,
+                "img_s_per_core": per_core}
+
+    curve = [cell(1, 100.0), cell(2, 80.0), cell(4, 74.0),
+             cell(8, 76.0)]
+    knee = find_knee(curve, knee_frac=0.75)
+    assert knee["knee_workers"] == 2  # 4 dipped below; 8 is noise
+    assert not knee["linear_through_max_tested"]
+    # Monotone-above-bar curve: knee = max tested.
+    flat = [cell(1, 100.0), cell(2, 90.0), cell(4, 85.0)]
+    knee = find_knee(flat, knee_frac=0.75)
+    assert knee["knee_workers"] == 4
+    assert knee["linear_through_max_tested"]

@@ -53,6 +53,13 @@ def _cfg(**kw):
     (dict(fused_mlp="on"), "--fused-mlp on requires a ConvNeXt"),
     (dict(arch="vit_b16", fused_mlp="on"),
      "--fused-mlp on requires a ConvNeXt"),
+    (dict(workers=-1), "--workers must be >= 0"),
+    (dict(input_wait_alert=1.5), "--input-wait-alert"),
+    (dict(input_wait_alert=-0.1), "--input-wait-alert"),
+    (dict(decode_offload="h:1"),
+     "--decode-offload applies to the imagefolder/tar"),
+    (dict(dataset="imagefolder", decode_offload="nonsense"),
+     "not host:port"),
 ])
 def test_invalid_combinations_rejected(kw, match):
     with pytest.raises(ValueError, match=match):

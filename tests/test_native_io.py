@@ -56,12 +56,31 @@ def test_bmp_and_webp_match_pil(tmp_path):
     arr = rng.integers(0, 255, (40, 56, 3), dtype=np.uint8)
     pb = str(tmp_path / "a.bmp")
     Image.fromarray(arr).save(pb)
+    paths = [pb]
+    if native_loader.has_webp():
+        # Optional decoder (IL_NO_WEBP builds route webp to the PIL
+        # rescue at the loader level — covered below).
+        pw = str(tmp_path / "a.webp")
+        Image.fromarray(arr).save(pw, lossless=True)
+        paths.append(pw)
+    out, ok = native_loader.decode_resize_batch(paths, 32, MEAN, STD)
+    assert ok.all()
+    for i, p in enumerate(paths):
+        assert np.abs(out[i] - _pil_ref(p, 32)).max() < 0.02
+
+
+def test_webp_without_native_support_rescued_by_pil(tmp_path):
+    """An IL_NO_WEBP build must report webp rows not-ok (never decode
+    them wrong), and the batch API's contract — caller re-decodes the
+    ~ok rows — still delivers the pixels via the loader's PIL rescue."""
+    if native_loader.has_webp():
+        pytest.skip("this build decodes webp natively")
+    rng = np.random.default_rng(1)
+    arr = rng.integers(0, 255, (40, 56, 3), dtype=np.uint8)
     pw = str(tmp_path / "a.webp")
     Image.fromarray(arr).save(pw, lossless=True)
-    out, ok = native_loader.decode_resize_batch([pb, pw], 32, MEAN, STD)
-    assert ok.all()
-    for i, p in enumerate([pb, pw]):
-        assert np.abs(out[i] - _pil_ref(p, 32)).max() < 0.02
+    out, ok = native_loader.decode_resize_batch([pw], 32, MEAN, STD)
+    assert not ok[0] and np.abs(out[0]).max() == 0.0
 
 
 def test_dct_scaled_decode_close_in_mean(tmp_path):
@@ -194,7 +213,12 @@ def test_native_matches_python_fallback_pipeline(tmp_path):
     pyl = ImageFolderLoader(base.replace(native_io=False), 0, 1, 6, "train")
     (bn,), (bp,) = list(nat.epoch(0)), list(pyl.epoch(0))
     np.testing.assert_array_equal(bn.labels, bp.labels)
-    assert np.abs(bn.images - bp.images).max() < 0.02
+    # uint8 wire batches: widen before differencing (a -1 would wrap to
+    # 255) and allow the ±1 rounding skew between the native triangle
+    # resampler and PIL's (different libjpeg builds round the last ULP
+    # differently; anything >1 is a real decode divergence).
+    diff = np.abs(bn.images.astype(np.int16) - bp.images.astype(np.int16))
+    assert diff.max() <= 1
 
 
 def test_crop_sampler_cross_path_parity():
@@ -235,11 +259,17 @@ def test_augmented_decode_pixel_parity(tmp_path):
     p = str(tmp_path / "a.jpg")
     Image.fromarray(_smooth(300, 400)).save(p, quality=95)
     size = 224
-    _init_worker(size, MEAN, STD)
+    _init_worker(size)  # PIL path: uint8 wire, no host normalization
     seeds = np.asarray([3, 11, 12345, 999_999_937], np.uint64)
-    out, ok = native_loader.decode_resize_batch(
-        [p] * len(seeds), size, MEAN, STD, aug_seeds=seeds)
+    # Drive the native side through the uint8 wire entry point the
+    # loaders actually use, so both sides land on the raw [0, 255]
+    # scale; the crop/flip parity comes from the shared splitmix64
+    # stream, the tolerance covers the resampler difference (~2.5
+    # uint8 steps ≈ the historical 0.02 on the normalized scale).
+    out, ok = native_loader.decode_batch_uint8(
+        [p] * len(seeds), size, aug_seeds=seeds)
     assert ok.all()
     for i, seed in enumerate(seeds):
         pil = _decode_one(p, int(seed))
-        assert np.abs(out[i] - pil).mean() < 0.02, int(seed)
+        diff = np.abs(out[i].astype(np.int16) - pil.astype(np.int16))
+        assert diff.mean() < 2.5, int(seed)

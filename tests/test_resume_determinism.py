@@ -1,0 +1,122 @@
+"""Mid-epoch-resume determinism (ISSUE 11 acceptance): a REAL
+2-process CPU pod is preempted mid-epoch via the registered ``sigterm``
+fault, ``--resume``d, and the concatenated per-rank consumed-sample
+index sequences must equal the uninterrupted stream contract's —
+byte-identical, no sample replayed, none skipped. Drilled e2e for the
+synthetic and imagefolder loaders (tarshards and the native decode
+path share the exact same ``data/stream.py`` contract, pinned
+loader-by-loader in tests/test_stream.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from imagent_tpu.data.stream import (
+    PAD_ROW, StreamKey, open_stream, read_trace,
+)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+
+GLOBAL_BATCH = 16  # batch 4 x (2 procs x 2 fake devices)
+N_TRAIN = 256      # -> 16 steps/epoch; the agreed stop lands at 8
+
+
+def _build_imagefolder(root: str) -> None:
+    rng = np.random.default_rng(0)
+    for split, n_per_class in (("train", N_TRAIN // 2), ("val", 4)):
+        for c in ("clsa", "clsb"):
+            d = os.path.join(root, split, c)
+            os.makedirs(d)
+            for i in range(n_per_class):
+                arr = rng.integers(0, 255, size=(20, 20, 3),
+                                   dtype=np.uint8)
+                Image.fromarray(arr).save(os.path.join(d, f"{i}.jpg"),
+                                          quality=90)
+
+
+def _launch(phase: str, dataset: str, scratch: str,
+            timeout: float = 300) -> list[str]:
+    from mp_launch import clean_env, free_port
+    port = free_port()
+    env = clean_env()
+    env["IMAGENT_MP_SCRATCH"] = scratch
+    env["IMAGENT_RESUME_PHASE"] = phase
+    env["IMAGENT_RESUME_DATASET"] = dataset
+    env.pop("IMAGENT_FAULTS", None)  # rank 0 arms its own, inside
+    env.pop("IMAGENT_SAMPLE_TRACE", None)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(_DIR, "mp_worker_resume.py"),
+         str(rank), str(port), "2"],
+        cwd=_REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+        for rank in range(2)]
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"rank failed:\n{out}"
+    return outs
+
+
+def _expected_rows(rank: int, num_examples: int) -> list[list[int]]:
+    """The uninterrupted run's per-rank train sample sequence, from
+    the pure stream contract (pinned against real loader iteration in
+    tests/test_stream.py — so contract == loader == engine)."""
+    key = StreamKey(num_examples=num_examples,
+                    global_batch=GLOBAL_BATCH, seed=0,
+                    process_index=rank, process_count=2, shuffle=True,
+                    drop_remainder=True)
+    return [[int(x) for x in rows[rows != PAD_ROW]]
+            for _, rows in open_stream(key, epoch=0)]
+
+
+@pytest.mark.parametrize("dataset", ["synthetic", "imagefolder"])
+def test_mid_epoch_resume_replays_and_skips_nothing(dataset, tmp_path):
+    scratch = str(tmp_path)
+    if dataset == "imagefolder":
+        _build_imagefolder(os.path.join(scratch, "data"))
+
+    outs = _launch("kill", dataset, scratch)
+    assert all("KILL_OK" in o for o in outs), outs
+    with open(os.path.join(scratch, "ck", "last_meta.json")) as f:
+        resume_step = int(json.load(f)["resume_step"])
+    # The fault fires at step 4; the multi-host any-reduce agrees the
+    # stop at the next step-8 boundary — genuinely mid-epoch.
+    assert 0 < resume_step < 16, resume_step
+
+    outs2 = _launch("resume", dataset, scratch)
+    assert all("RESUME_OK" in o for o in outs2), outs2
+    assert any(f"resumed from epoch 0 step {resume_step}" in o
+               for o in outs2), outs2
+
+    for rank in (0, 1):
+        expected = _expected_rows(rank, N_TRAIN)
+        kill = read_trace(os.path.join(scratch, "trace_kill"), rank)
+        resume = read_trace(os.path.join(scratch, "trace_resume"),
+                            rank)
+        # The kill-phase trace records PRODUCED batches: a strict
+        # prefix of the stream (the producer may stage a few past the
+        # last APPLIED step — those are exactly what resume replays).
+        assert len(kill) >= resume_step, (rank, len(kill))
+        for i, rec in enumerate(kill):
+            assert (rec["epoch"], rec["step"]) == (0, i), rec
+            assert rec["rows"] == expected[i], (rank, i)
+        # Resume opened the stream at (0, resume_step) — its first
+        # produced batch is exactly the first unapplied one.
+        train_resume = [r for r in resume if r["epoch"] == 0]
+        assert [r["step"] for r in train_resume] \
+            == list(range(resume_step, len(expected))), rank
+        # THE acceptance property: applied-prefix + resumed-suffix ==
+        # the uninterrupted sequence, byte-identical, per rank.
+        consumed = ([r["rows"] for r in kill[:resume_step]]
+                    + [r["rows"] for r in train_resume])
+        assert consumed == expected, f"rank {rank} replayed or skipped"

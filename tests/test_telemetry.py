@@ -375,3 +375,72 @@ def test_pod_telemetry_two_process_engine_run(tmp_path):
     assert "frontier: epoch 2/2" in proc.stdout, proc.stdout
     assert "health: grad_norm ewma" in proc.stdout, proc.stdout
     assert "goodput" in proc.stdout, proc.stdout
+
+
+def test_input_wait_alert_fraction_and_streak(tmp_path):
+    """--input-wait-alert unit semantics: an epoch whose input_wait
+    fraction of wall exceeds the threshold gets an alert record (event
+    + WARN handled at the session level), consecutive offenders grow
+    the streak, and a clean epoch resets it."""
+    import time as _time
+
+    cfg = Config(log_dir=str(tmp_path), input_wait_alert=0.10)
+    telem = TelemetrySession(cfg, is_master=True)
+    telem.run_start({})
+
+    def one_epoch(i, wait_frac):
+        telem.epoch_begin()
+        t0 = _time.perf_counter()
+        while _time.perf_counter() - t0 < 0.05:
+            pass  # wall must be real: the accountant measures it
+        wall = _time.perf_counter() - t0
+        telem.phase("input_wait", wall * wait_frac)
+        return telem.epoch_end(i, {})
+
+    r0 = one_epoch(0, 0.5)
+    a0 = r0.get("input_wait_alert")
+    assert a0 and a0["streak"] == 1 and a0["fraction"] > 0.10
+    assert a0["worst_host"] == 0  # single process: host 0 by definition
+    r1 = one_epoch(1, 0.5)
+    assert r1["input_wait_alert"]["streak"] == 2
+    r2 = one_epoch(2, 0.0)
+    assert "input_wait_alert" not in r2  # clean epoch resets
+    r3 = one_epoch(3, 0.5)
+    assert r3["input_wait_alert"]["streak"] == 1
+    telem.run_end({})
+    from imagent_tpu.telemetry.events import read_events
+    evs = read_events(str(tmp_path / "telemetry.jsonl"))
+    alerts = [e for e in evs if e.get("event") == "input_wait_alert"]
+    assert [a["epoch"] for a in alerts] == [0, 1, 3]
+
+
+def test_input_wait_alert_disabled_by_zero(tmp_path):
+    cfg = Config(log_dir=str(tmp_path), input_wait_alert=0.0)
+    telem = TelemetrySession(cfg, is_master=True)
+    telem.run_start({})
+    telem.epoch_begin()
+    telem.phase("input_wait", 100.0)
+    record = telem.epoch_end(0, {})
+    assert "input_wait_alert" not in record
+    telem.run_end({})
+
+
+def test_eval_input_partitioned_from_train(tmp_path):
+    """absorb_eval_input must land in the eval counters, never the
+    train input_wait phase the alert threshold judges."""
+    from imagent_tpu.data.prefetch import PrefetchStats
+
+    cfg = Config(log_dir=str(tmp_path), input_wait_alert=0.10)
+    telem = TelemetrySession(cfg, is_master=True)
+    telem.run_start({})
+    telem.epoch_begin()
+    ev = PrefetchStats()
+    ev.wait_s = 123.0
+    ev.bytes_staged = 2_000_000
+    telem.absorb_eval_input(ev)
+    record = telem.epoch_end(0, {})
+    assert record["phases"]["input_wait"] == 0.0
+    assert record["counters"]["eval_input_wait_s"] == 123.0
+    assert record["counters"]["eval_h2d_mb"] == 2.0
+    assert "input_wait_alert" not in record  # eval wait never alerts
+    telem.run_end({})
