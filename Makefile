@@ -5,7 +5,7 @@ PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
 
 .PHONY: smoke test lint bench-smoke bench-anatomy bench-input \
-	drill-pod drill-divergence
+	drill-pod drill-divergence trace-smoke
 
 # Static-analysis gate (docs/STATIC_ANALYSIS.md): jaxlint — the
 # JAX/TPU-aware rules in imagent_tpu/analysis — over the package, the
@@ -56,6 +56,14 @@ drill-pod:
 drill-divergence:
 	$(PYTEST) -m "not slow" tests/test_health.py
 	$(PYTEST) -m "not slow" tests/test_fault_drills.py -k divergence
+
+# Pod tracer suite (docs/OPERATIONS.md "Reading a pod trace"): the
+# span recorder / torn-tail reader / skew-corrected merge unit tests,
+# the engine trace drills (phases + steps modes, fatal-exit flushes,
+# --trace off = zero files), and the Chrome-trace-schema validation.
+# All tier-1; this target is the focused loop for the tracing layer.
+trace-smoke:
+	$(PYTEST) -m "not slow" tests/test_trace.py
 
 # Tiny synthetic-data bench iteration through the real input path
 # (uint8 wire -> device_prefetch -> in-graph normalize -> step) on the

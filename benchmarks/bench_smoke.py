@@ -26,6 +26,14 @@ epoch 0 — ``eval_every=2`` keeps it free of the eval and BEST-save
 costs both runs pay identically (and synchronously) at the final
 epoch.
 
+Stage 3 — pod tracer gate: a 2-epoch engine run with ``--trace
+phases`` must produce span files whose PHASE spans sum to within 5% of
+epoch wall of the goodput accountant's phases (both ride the same
+measurements — ``TelemetrySession.phase``/``record_dispatch`` — so
+drift means an emission site was dropped or double-fired), and merge
+into a ``trace.json`` that validates against the Chrome trace event
+schema (``telemetry/trace.py``).
+
 Prints one JSON line per stage and exits non-zero on any crash, a
 non-finite loss, or a telemetry-regression violation.
 """
@@ -206,11 +214,96 @@ def _ckpt_regression_stage() -> int:
     return 1 if failures else 0
 
 
+def _trace_stage() -> int:
+    """Stage 3 — pod tracer gate: a 2-epoch engine run with ``--trace
+    phases`` must (a) produce a per-rank span file whose PHASE spans
+    sum to within 5% of epoch wall of the goodput accountant's phases
+    (the two ride the same measurements — drift means a span emission
+    site was dropped or double-fired), (b) merge into a Chrome-trace-
+    format ``trace.json`` that passes the schema validator, with the
+    clock-offset record present, and (c) drop no spans at the default
+    buffer on this tiny run."""
+    import tempfile
+
+    from imagent_tpu.config import Config
+    from imagent_tpu.engine import run
+    from imagent_tpu.telemetry import read_events
+    from imagent_tpu.telemetry import trace as trace_lib
+
+    root = tempfile.mkdtemp(prefix="bench_trace_")
+    log_dir = os.path.join(root, "tb")
+    cfg = Config(arch="resnet18", image_size=16, num_classes=4,
+                 batch_size=4, epochs=2, lr=0.05, dataset="synthetic",
+                 synthetic_size=128, workers=0, bf16=False, log_every=0,
+                 seed=0, save_model=True, keep_last_k=1, eval_every=1,
+                 trace="phases", log_dir=log_dir,
+                 ckpt_dir=os.path.join(root, "ck"))
+    result = run(cfg)
+    if result["preempted"] or result["rollbacks"]:
+        print(f"FAIL: trace run degraded: {result}", file=sys.stderr)
+        return 1
+
+    failures = []
+    epochs = [e for e in read_events(
+        os.path.join(log_dir, "telemetry.jsonl"))
+        if e["event"] == "epoch"]
+    wall = sum(rec["wall_s"] for rec in epochs)
+    acct = sum(v for rec in epochs
+               for k, v in rec["phases"].items() if k != "host_other")
+    dropped = sum((rec.get("trace") or {}).get("dropped", 0)
+                  for rec in epochs)
+    traces = trace_lib.load_run_traces(log_dir)
+    if not traces:
+        print("FAIL: --trace phases produced no trace files",
+              file=sys.stderr)
+        return 1
+    spans = [sp for _rank, _hdr, sps in traces for sp in sps]
+    traced = sum(trace_lib.phase_span_seconds(spans).values())
+    # The consistency gate: the tracer and the accountant must tell
+    # the same story about where the wall went.
+    if abs(traced - acct) > 0.05 * wall:
+        failures.append(
+            f"traced phase spans sum {traced:.3f}s vs goodput phases "
+            f"{acct:.3f}s — differ by more than 5% of epoch wall "
+            f"{wall:.3f}s")
+    if dropped:
+        failures.append(f"{dropped} spans dropped at the default "
+                        "buffer on a 2-epoch smoke run")
+    if not any(rec.get("clock") for rec in epochs):
+        failures.append("epoch records carry no clock-offset record")
+    obj = trace_lib.merge(log_dir)
+    errs = trace_lib.validate_chrome_trace(obj)
+    out = None
+    if errs:
+        # Same refusal as the CLI: never ship a trace.json that
+        # Perfetto will choke on.
+        failures.append("merged trace.json fails Chrome-trace "
+                        f"validation: {errs[:3]}")
+    else:
+        out = trace_lib.write_merged(log_dir, obj=obj)
+    print(json.dumps({
+        "metric": "bench_trace",
+        "status": "FAIL" if failures else "PASS",
+        "traced_phase_s": round(traced, 3),
+        "goodput_phase_s": round(acct, 3),
+        "wall_s": round(wall, 3),
+        "spans": sum((rec.get("trace") or {}).get("spans", 0)
+                     for rec in epochs),
+        "merged": out,
+    }))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def main() -> int:
     rc = _input_path_stage()
     if rc:
         return rc
-    return _ckpt_regression_stage()
+    rc = _ckpt_regression_stage()
+    if rc:
+        return rc
+    return _trace_stage()
 
 
 if __name__ == "__main__":

@@ -52,6 +52,7 @@ import orbax.checkpoint as ocp
 
 from imagent_tpu.resilience import deadman, faultinject, integrity
 from imagent_tpu.resilience.retry import retry_call
+from imagent_tpu.telemetry import trace as trace_lib
 from imagent_tpu.train import TrainState, host_snapshot, snapshotable
 
 BEST = "best"
@@ -507,6 +508,7 @@ def _commit_snapshot(ckpt_dir: str, name: str, host_state, meta: dict,
     import shutil
 
     t0 = time.monotonic()
+    t0_span = time.perf_counter()
     window = {"start": time.time(), "end": None, "ok": None}
     staging = os.path.join(ckpt_dir, name + _STAGING)
     try:
@@ -528,6 +530,15 @@ def _commit_snapshot(ckpt_dir: str, name: str, host_state, meta: dict,
         result = {"ok": False, "error": f"{type(e).__name__}: {e}"}
     result["secs"] = time.monotonic() - t0
     result["name"] = name
+    # The committer thread's own span (its tid names the thread in the
+    # merged timeline): the whole serialize+rotate+manifest window,
+    # with the generation and verdict as attrs. Emitted AFTER the
+    # verdict so a failed commit is labeled as one.
+    trace_lib.complete(
+        "ckpt/commit", t0_span, time.perf_counter(), cat="ckpt",
+        ckpt=name, generation=int(meta.get("epoch", -1)),
+        resume_step=int(meta.get("resume_step", 0)),
+        verdict="ok" if result["ok"] else "fail")
     window["end"] = time.time()
     window["ok"] = result["ok"]
     _commit_windows.append(window)
@@ -655,7 +666,8 @@ def save_async(ckpt_dir: str, name: str, state: TrainState, meta: dict,
              keep_last_k=keep_last_k)
         return landed
     if jax.process_index() == 0:
-        snap = host_snapshot(state)  # the blocking slice
+        with trace_lib.span("ckpt/snapshot", cat="ckpt", ckpt=name):
+            snap = host_snapshot(state)  # the blocking slice
         _write_pending_marker(ckpt_dir, name, meta)
         _commit_result = None
         _commit_started_at = time.monotonic()
@@ -729,19 +741,23 @@ def save_emergency(ckpt_dir: str, name: str, state: TrainState,
               "needs the dead peer; the last committed generation "
               "stands", flush=True)
         return False
-    snap = host_snapshot(state)
-    staging = os.path.join(ckpt_dir, name + _STAGING)
-    os.makedirs(ckpt_dir, exist_ok=True)
-    _write_pending_marker(ckpt_dir, name, meta)
-    try:
-        _write_snapshot(staging, snap, meta)
-        _commit_files(ckpt_dir, name, meta, keep_last_k)
-    except BaseException:
-        # The previous generation must survive an emergency gone wrong.
-        shutil.rmtree(staging, ignore_errors=True)
-        _clear_pending_marker(ckpt_dir, name)
-        raise
-    _join_manifest()  # the process is about to exit: full durability
+    with trace_lib.span("ckpt/emergency", cat="ckpt",
+                        epoch=int(meta.get("epoch", -1)),
+                        resume_step=int(meta.get("resume_step", 0))):
+        snap = host_snapshot(state)
+        staging = os.path.join(ckpt_dir, name + _STAGING)
+        os.makedirs(ckpt_dir, exist_ok=True)
+        _write_pending_marker(ckpt_dir, name, meta)
+        try:
+            _write_snapshot(staging, snap, meta)
+            _commit_files(ckpt_dir, name, meta, keep_last_k)
+        except BaseException:
+            # The previous generation must survive an emergency gone
+            # wrong.
+            shutil.rmtree(staging, ignore_errors=True)
+            _clear_pending_marker(ckpt_dir, name)
+            raise
+        _join_manifest()  # about to exit: full durability
     return True
 
 
@@ -1196,51 +1212,64 @@ def restore_resilient(ckpt_dir: str, target: TrainState, name: str = LAST,
     """
     wait_until_finished()  # a just-written checkpoint must be durable
     errors: list[str] = []
+    # Each rung of the fallback walk is a `ckpt/candidate` span with
+    # the verdict as an attr, so the merged timeline shows WHAT a slow
+    # recovery spent its time on — per-candidate hashing, probing, and
+    # the restores themselves.
     for cand in _pod_candidates(ckpt_dir, name):
         path = os.path.join(ckpt_dir, cand)
-        if not _pod_agree(os.path.isdir(path)):
-            continue
-        ok, detail = _verified_globally(ckpt_dir, cand)
-        if not ok:
-            print(f"WARNING: checkpoint {path} failed integrity "
-                  f"verification ({detail}); trying the next fallback",
-                  flush=True)
-            errors.append(f"{cand}: {detail}")
-            continue
-        probe_ok, probe_detail = integrity.probe(ckpt_dir, cand)
-        if not probe_ok:
-            print(f"WARNING: checkpoint {path} failed the local "
-                  f"readability probe on this host ({probe_detail}); "
-                  "the whole pod falls back together", flush=True)
-            errors.append(f"{cand}: {probe_detail}")
-        if not _pod_agree(probe_ok):
-            if probe_ok:
-                print(f"NOTE: checkpoint {path} probes clean on this "
-                      "host but is torn on a peer; advancing to the "
-                      "next fallback on every host (split-brain guard)",
-                      flush=True)
-                errors.append(f"{cand}: torn on a peer process")
-            continue
-        try:
-            restored = restore(ckpt_dir, cand, target)
-            local_ok = restored is not None
-        except Exception as e:
-            restored, local_ok = None, False
-            print(f"WARNING: checkpoint {path} failed to restore "
-                  f"({type(e).__name__}: {e}); trying the next fallback",
-                  flush=True)
-            errors.append(f"{cand}: {type(e).__name__}")
-        if not _pod_agree(local_ok):
-            if local_ok:
-                # This host's copy restored fine but a peer's threw:
-                # discard the local result and advance WITH the pod —
-                # returning here would split the run between candidates.
-                print(f"NOTE: checkpoint {path} restored on this host "
-                      "but failed on a peer; advancing to the next "
-                      "fallback on every host (split-brain guard)",
-                      flush=True)
-                errors.append(f"{cand}: failed on a peer process")
-            continue
+        with trace_lib.span("ckpt/candidate", cat="ckpt",
+                            candidate=cand) as cand_span:
+            if not _pod_agree(os.path.isdir(path)):
+                cand_span.set(outcome="absent")
+                continue
+            ok, detail = _verified_globally(ckpt_dir, cand)
+            if not ok:
+                print(f"WARNING: checkpoint {path} failed integrity "
+                      f"verification ({detail}); trying the next "
+                      "fallback", flush=True)
+                errors.append(f"{cand}: {detail}")
+                cand_span.set(outcome="integrity-failed")
+                continue
+            probe_ok, probe_detail = integrity.probe(ckpt_dir, cand)
+            if not probe_ok:
+                print(f"WARNING: checkpoint {path} failed the local "
+                      f"readability probe on this host "
+                      f"({probe_detail}); the whole pod falls back "
+                      "together", flush=True)
+                errors.append(f"{cand}: {probe_detail}")
+            if not _pod_agree(probe_ok):
+                if probe_ok:
+                    print(f"NOTE: checkpoint {path} probes clean on "
+                          "this host but is torn on a peer; advancing "
+                          "to the next fallback on every host "
+                          "(split-brain guard)", flush=True)
+                    errors.append(f"{cand}: torn on a peer process")
+                cand_span.set(outcome="probe-failed")
+                continue
+            try:
+                restored = restore(ckpt_dir, cand, target)
+                local_ok = restored is not None
+            except Exception as e:
+                restored, local_ok = None, False
+                print(f"WARNING: checkpoint {path} failed to restore "
+                      f"({type(e).__name__}: {e}); trying the next "
+                      "fallback", flush=True)
+                errors.append(f"{cand}: {type(e).__name__}")
+            if not _pod_agree(local_ok):
+                if local_ok:
+                    # This host's copy restored fine but a peer's
+                    # threw: discard the local result and advance WITH
+                    # the pod — returning here would split the run
+                    # between candidates.
+                    print(f"NOTE: checkpoint {path} restored on this "
+                          "host but failed on a peer; advancing to "
+                          "the next fallback on every host "
+                          "(split-brain guard)", flush=True)
+                    errors.append(f"{cand}: failed on a peer process")
+                cand_span.set(outcome="restore-failed")
+                continue
+            cand_span.set(outcome="restored")
         if cand != name:
             print(f"NOTE: restored fallback checkpoint {path} "
                   f"(earlier candidates failed: {'; '.join(errors)})",

@@ -181,6 +181,21 @@ class Config:
     # referenced from the tombstone. 0 disables.
     flightrec_steps: int = 256
 
+    # ---- pod tracer (telemetry/trace.py) ----
+    # Cross-host span timeline: every subsystem (engine phases,
+    # checkpoint snapshot/commit/restore, staging-queue waits, offload
+    # requests, deadman verdicts) emits spans into per-thread rings,
+    # flushed as runs/<run>/trace/trace.<rank>.jsonl at each epoch
+    # boundary and on every fatal ramp; `python -m imagent_tpu
+    # .telemetry trace <run_dir>` merges them into one skew-corrected
+    # Perfetto-loadable trace.json. "phases" coalesces per-step
+    # dispatches into windows; "steps" records every dispatch
+    # individually (one span per optimizer step). Off by default: off
+    # means NO recorder — zero files, zero ring cost.
+    trace: str = "off"
+    # Spans kept per thread between flushes (oldest dropped, counted).
+    trace_buffer: int = 4096
+
     # ---- resilience (imagent_tpu/resilience/) ----
     # Non-finite step guard: bad steps are always skipped in-graph
     # (train.py); after this many CONSECUTIVE skipped steps the engine
@@ -449,6 +464,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "step/health records flushed as "
                         "flightrec.<rank>.json on fatal exits "
                         "(0 disables)")
+    # Pod tracer.
+    p.add_argument("--trace", type=str, default=c.trace,
+                   choices=["off", "phases", "steps"],
+                   help="cross-host span timeline (telemetry/trace.py)"
+                        ": phases = phase boundaries + coalesced "
+                        "dispatch windows, steps = every dispatch "
+                        "individually; per-rank trace/trace.<rank>"
+                        ".jsonl merged by `python -m imagent_tpu"
+                        ".telemetry trace` into Perfetto-loadable "
+                        "trace.json (off = no recorder, zero cost)")
+    p.add_argument("--trace-buffer", type=int, default=c.trace_buffer,
+                   help="spans kept per thread between trace flushes "
+                        "(oldest dropped and counted; default 4096)")
     # Resilience subsystem.
     p.add_argument("--max-bad-steps", type=int, default=c.max_bad_steps,
                    help="consecutive non-finite (skipped) steps before "

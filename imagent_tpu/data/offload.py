@@ -50,6 +50,7 @@ import time
 import numpy as np
 
 from imagent_tpu.resilience.retry import retry_call
+from imagent_tpu.telemetry import trace as trace_mod
 
 PROTOCOL_VERSION = 1
 
@@ -250,6 +251,12 @@ class OffloadClient:
             ep = self._eps[(self._rr + k) % n]
             if ep.down_until > now:
                 continue
+            # Each attempted endpoint is one `data/offload` span
+            # (endpoint + retry-state attrs): a degrading offload pool
+            # shows up in the merged timeline as lengthening request
+            # spans and error-tagged ones — not just an end-of-epoch
+            # fallback counter.
+            t0_span = time.perf_counter()
             try:
                 images, labels, q = retry_call(
                     self._request, ep, rows, epoch,
@@ -264,9 +271,22 @@ class OffloadClient:
                         "this run's dataset")
                 ep.fails = 0
                 self._rr = (self._rr + k + 1) % n
+                trace_mod.complete(
+                    "data/offload", t0_span, time.perf_counter(),
+                    cat="data", endpoint=ep.name, rows=int(len(rows)),
+                    ok=True)
                 return images, q
             except (OSError, ValueError, KeyError, struct.error) as e:
                 self._mark_down(ep, e)
+                trace_mod.complete(
+                    "data/offload", t0_span, time.perf_counter(),
+                    cat="data", endpoint=ep.name, rows=int(len(rows)),
+                    ok=False, error=type(e).__name__,
+                    retries=int(ep.fails))
+        # Every endpoint down/unreachable: the batch falls back to
+        # LOCAL decode — an instant marks the moment on the timeline.
+        trace_mod.instant("data/offload_fallback", cat="data",
+                          rows=int(len(rows)))
         return None, 0
 
     def close(self) -> None:

@@ -32,6 +32,8 @@ import threading
 import time
 from typing import Callable, Iterator
 
+from imagent_tpu.telemetry import trace as trace_mod
+
 # NOTE: no top-level jax/train import. The device-staging half of this
 # module (``_stage_batch`` → ``train.shard_batch``) imports lazily:
 # the host-only half (``PrefetchStats``/``iter_with_producer``) is on
@@ -39,7 +41,8 @@ from typing import Callable, Iterator
 # re-imports ``data/imagefolder.py`` in a fresh interpreter) and of the
 # decode-offload service (``data/serve.py``) — pulling jax there costs
 # seconds of startup and a device registry nothing uses (asserted
-# jax-free-by-import in tests/test_stream.py).
+# jax-free-by-import in tests/test_stream.py; ``telemetry.trace`` is
+# itself jax-free and rides the same contract).
 
 
 class PrefetchStats:
@@ -69,8 +72,22 @@ class PrefetchStats:
         self.batches = 0
 
 
+def _trace_wait(trace_name: str, t0: float, waited: float) -> None:
+    """A recorded staging-queue wait (telemetry/trace.py): the span the
+    timeline shows WHERE the step loop starved. Train-side waits are
+    ``input_wait`` PHASE spans (summed by the spans-vs-goodput gate);
+    any other name (eval, benches) is a plain data span. Sub-ms waits
+    are scheduler noise and stay span-free."""
+    if waited > trace_mod.MIN_WAIT_SPAN_S and \
+            trace_mod.active() is not None:
+        cat = (trace_mod.PHASE_CAT if trace_name == "input_wait"
+               else "data")
+        trace_mod.complete(trace_name, t0, t0 + waited, cat=cat)
+
+
 def iter_with_producer(produce: Callable, maxsize: int,
-                       stats: PrefetchStats | None = None) -> Iterator:
+                       stats: PrefetchStats | None = None,
+                       trace_name: str = "input_wait") -> Iterator:
     """Yield items that ``produce(put)`` stages from a daemon thread.
 
     ``produce`` receives a ``put(item) -> bool`` callback and should
@@ -119,6 +136,7 @@ def iter_with_producer(produce: Callable, maxsize: int,
                 stats.wait_s += waited
                 if waited > stats.max_wait_s:
                     stats.max_wait_s = waited
+                _trace_wait(trace_name, t0, waited)
             if item is _END:
                 break
             if isinstance(item, BaseException):
@@ -136,21 +154,36 @@ def iter_with_producer(produce: Callable, maxsize: int,
 
 def _stage_batch(mesh, batch, with_mask: bool,
                  stats: PrefetchStats | None):
-    """One ``data.pipeline.Batch`` → global device arrays (+ stats)."""
+    """One ``data.pipeline.Batch`` → global device arrays (+ stats).
+    With a tracer active, the staging work becomes a ``data/stage``
+    span on the PRODUCER thread (coalesced into windows in ``phases``
+    mode) — the decode/H2D side of the timeline the consumer's
+    ``input_wait`` spans starve on."""
     from imagent_tpu.train import shard_batch
     if stats is not None:
         stats.bytes_staged += (
             batch.images.nbytes + batch.labels.nbytes
             + (batch.mask.nbytes if with_mask else 0))
         stats.batches += 1
+    if trace_mod.active() is None:
+        if with_mask:
+            return shard_batch(mesh, batch.images, batch.labels,
+                               batch.mask)
+        return shard_batch(mesh, batch.images, batch.labels)
+    t0 = time.perf_counter()
     if with_mask:
-        return shard_batch(mesh, batch.images, batch.labels, batch.mask)
-    return shard_batch(mesh, batch.images, batch.labels)
+        out = shard_batch(mesh, batch.images, batch.labels, batch.mask)
+    else:
+        out = shard_batch(mesh, batch.images, batch.labels)
+    trace_mod.complete("data/stage", t0, time.perf_counter(),
+                       cat="data", merge=True)
+    return out
 
 
 def device_prefetch(mesh, batch_iter, with_mask: bool = False,
                     depth: int = 2,
-                    stats: PrefetchStats | None = None) -> Iterator[tuple]:
+                    stats: PrefetchStats | None = None,
+                    trace_name: str = "input_wait") -> Iterator[tuple]:
     """Yield tuples of global device arrays, staged ``depth`` ahead
     (``--prefetch-depth``).
 
@@ -172,7 +205,8 @@ def device_prefetch(mesh, batch_iter, with_mask: bool = False,
                 return
 
     try:
-        yield from iter_with_producer(produce, depth, stats)
+        yield from iter_with_producer(produce, depth, stats,
+                                      trace_name=trace_name)
     finally:
         # Close the source iterator so its own resources (decode pools,
         # producer threads) unwind deterministically too.
@@ -199,8 +233,10 @@ class Prefetcher:
     """
 
     def __init__(self, mesh, batch_iter, with_mask: bool = False,
-                 depth: int = 2, stats: PrefetchStats | None = None):
+                 depth: int = 2, stats: PrefetchStats | None = None,
+                 trace_name: str = "input_wait"):
         self.stats = stats if stats is not None else PrefetchStats()
+        self._trace_name = trace_name
         self._batch_iter = batch_iter
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
@@ -243,6 +279,7 @@ class Prefetcher:
         self.stats.wait_s += waited
         if waited > self.stats.max_wait_s:
             self.stats.max_wait_s = waited
+        _trace_wait(self._trace_name, t0, waited)
         if item is self._end:
             self._done = True
             raise StopIteration

@@ -47,6 +47,7 @@ from imagent_tpu.schedule import lr_for_epoch
 from imagent_tpu.status import StatusWriter
 from imagent_tpu.telemetry import TelemetrySession, parse_profile_at_step
 from imagent_tpu.telemetry import flightrec as flightrec_lib
+from imagent_tpu.telemetry import trace as trace_lib
 from imagent_tpu.telemetry.health import HealthMonitor
 from imagent_tpu.train import (
     TrainState, create_train_state, make_eval_step, make_optimizer,
@@ -397,8 +398,12 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
             if telem is not None:
                 # Dispatch is async: this duration is µs on a steady
                 # step and seconds on a compiling one — the accountant
-                # splits compile from dispatch on that gap.
-                telem.record_dispatch(time.perf_counter() - t_dispatch)
+                # splits compile from dispatch on that gap (and, when
+                # tracing, the same measurement becomes the
+                # dispatch/compile span — per step or coalesced into
+                # windows by --trace mode).
+                telem.record_dispatch(time.perf_counter() - t_dispatch,
+                                      step=step_i)
             # The lagged frontier consumes the vector from _GUARD_LAG
             # steps ago (already retired — a free D2H, not a drain) and
             # carries the guard + log readout; NOTHING in this loop
@@ -513,9 +518,14 @@ def evaluate(cfg: Config, mesh, eval_step, state: TrainState, loader,
     # later shards are still dispatching, so the fetch cost hides
     # under the eval compute instead of serializing after it.
     acc = _LaggedMetrics()
+    # trace_name: eval-side queue waits become `eval_input` DATA spans,
+    # never `input_wait` PHASE spans — the spans-vs-goodput consistency
+    # gate judges the train step loop alone, mirroring the
+    # absorb_eval_input partition below.
     for images, labels, mask in device_prefetch(
             mesh, loader.epoch(epoch), with_mask=True,
-            depth=cfg.prefetch_depth, stats=stats):
+            depth=cfg.prefetch_depth, stats=stats,
+            trace_name="eval_input"):
         acc.push(eval_step(state, images, labels, mask))
     acc.drain()
     metrics = acc.summary()
@@ -709,6 +719,28 @@ def run(cfg: Config, stop_check=None) -> dict:
                            interval_secs=cfg.heartbeat_secs)
         pod.start()
         deadman_lib.activate(pod)
+    if cfg.trace not in trace_lib.MODES:
+        raise ValueError(f"--trace must be one of "
+                         f"{'|'.join(trace_lib.MODES)}, got "
+                         f"{cfg.trace!r}")
+    if cfg.trace_buffer < 1:
+        raise ValueError("--trace-buffer must be >= 1 (spans kept "
+                         "per thread between flushes)")
+    if cfg.trace != "off" and not cfg.telemetry:
+        raise ValueError("--trace rides the telemetry session (phase "
+                         "boundaries, the epoch-boundary flush, the "
+                         "clock allgather); drop --no-telemetry")
+    tracer = None
+    if cfg.trace != "off":
+        # Pod tracer (telemetry/trace.py): every subsystem emits spans
+        # through the module-global recorder; rings are flushed to
+        # trace/trace.<rank>.jsonl at each epoch boundary
+        # (TelemetrySession.epoch_end) and on every fatal ramp below —
+        # the same exits that flush the flight recorder.
+        tracer = trace_lib.TraceRecorder(
+            cfg.log_dir, jax.process_index(), mode=cfg.trace,
+            buffer=cfg.trace_buffer)
+        trace_lib.activate(tracer)
     recorder = None
     if cfg.flightrec_steps > 0 and cfg.health_stats:
         # Crash flight recorder (telemetry/flightrec.py): the last N
@@ -724,7 +756,15 @@ def run(cfg: Config, stop_check=None) -> dict:
         # Every tombstone write (all deliberate fatal ramps funnel
         # there, including the monitor threads' os._exit paths) first
         # flushes the flight recorder and references it in the detail.
-        pod.on_fatal = flightrec_lib.flush_active
+        # The span rings ride the same hook: a fatal exit's trace tail
+        # (the spans of the seconds before death) lands durably before
+        # the tombstone classifies the exit.
+        def _pod_fatal(reason, exit_code, detail=""):
+            trace_lib.flush_active(fsync=True)
+            return flightrec_lib.flush_active(reason, exit_code,
+                                              detail=detail)
+
+        pod.on_fatal = _pod_fatal
     guard = None
     if stop_check is None:
         stop_check = guard = PreemptionGuard()
@@ -738,12 +778,16 @@ def run(cfg: Config, stop_check=None) -> dict:
             # Hard-exit ramp: land the forensic record, then (with the
             # mesh armed) the classified tombstone so peers fail over
             # instantly instead of waiting out the staleness deadline.
+            # (With a pod, tombstone() reaches the trace flush through
+            # on_fatal; without one, flush here — the timeline of a
+            # hung run is exactly what the 86 post-mortem needs.)
             detail = "no step progress; main thread never polled"
             if pod is not None:
                 pod.tombstone("watchdog-hard-exit",
                               exitcodes.WATCHDOG_HARD_EXIT,
                               detail=detail)  # flushes via on_fatal
             else:
+                trace_lib.flush_active(fsync=True)
                 flightrec_lib.flush_active(
                     "watchdog-hard-exit",
                     exitcodes.WATCHDOG_HARD_EXIT, detail=detail)
@@ -753,15 +797,17 @@ def run(cfg: Config, stop_check=None) -> dict:
         return _run(cfg, stop_check, senv, watchdog, pod, recorder)
     except exitcodes.FatalRunError as e:
         # Classified fatal exits (peer death, storage outage, rollback
-        # give-up): flight recorder first (write-once — an exit ramp
-        # may have flushed already), then the tombstone; its writer's
-        # write-once guard keeps the first cause.
+        # give-up): span rings and flight recorder first (write-once —
+        # an exit ramp may have flushed already), then the tombstone;
+        # its writer's write-once guard keeps the first cause.
+        trace_lib.flush_active(fsync=True)
         flightrec_lib.flush_active(e.reason, e.exit_code,
                                    detail=str(e))
         if pod is not None:
             pod.tombstone(e.reason, e.exit_code, detail=str(e))
         raise
     except ValueError as e:
+        trace_lib.flush_active(fsync=True)
         flightrec_lib.flush_active("fatal-config",
                                    exitcodes.FATAL_CONFIG,
                                    detail=str(e))
@@ -770,6 +816,7 @@ def run(cfg: Config, stop_check=None) -> dict:
                           detail=str(e))
         raise
     except Exception as e:
+        trace_lib.flush_active(fsync=True)
         flightrec_lib.flush_active(
             "exception", exitcodes.FATAL_EXCEPTION,
             detail=f"{type(e).__name__}: {e}")
@@ -778,6 +825,9 @@ def run(cfg: Config, stop_check=None) -> dict:
                           detail=f"{type(e).__name__}: {e}")
         raise
     finally:
+        # Final flush (a clean exit's post-boundary spans: the last
+        # commit land, the torch export) + deactivate.
+        trace_lib.close_active()
         flightrec_lib.deactivate()
         if pod is not None:
             deadman_lib.deactivate()
@@ -1392,6 +1442,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
 
     anomaly_hwm = [0]  # monitor.anomalies already attributed to epochs
     last_input_alert = [None]  # newest epoch's input-wait alert (if any)
+    last_clock_skew = [None]   # newest epoch's max pod wall-clock skew
 
     def _end_telemetry_epoch(ep: int, tm: dict,
                              interrupted: bool = False,
@@ -1422,6 +1473,8 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                         round(pod.max_peer_staleness(), 3))
         record = telem.epoch_end(ep, tm, interrupted=interrupted)
         last_input_alert[0] = (record or {}).get("input_wait_alert")
+        last_clock_skew[0] = ((record or {}).get("clock")
+                              or {}).get("max_skew_s")
         if status is not None:
             # Epoch-boundary status write: covers --log-every 0 runs
             # and adds the goodput the in-epoch writes can't know yet.
@@ -1440,6 +1493,10 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                 # The input-bound alert (when tripped): the status CLI
                 # renders it so a starving pod is visible at a glance.
                 "input_wait_alert": last_input_alert[0],
+                # Max pod wall-clock skew from the epoch's clock
+                # allgather: skewed clocks break cross-rank log
+                # reading, and this is the one place that measures it.
+                "clock_skew_s": last_clock_skew[0],
                 "degraded": bool(pod is not None and pod.degraded),
                 "interrupted": bool(interrupted),
                 "health": (monitor.snapshot()
@@ -1782,6 +1839,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
             # input-bound should say so on its last status surface,
             # not only in the per-epoch telemetry log.
             "input_wait_alert": last_input_alert[0],
+            "clock_skew_s": last_clock_skew[0],
             "degraded": bool(pod is not None and pod.degraded),
             "health": (monitor.snapshot()
                        if monitor is not None else None),

@@ -58,6 +58,7 @@ import time
 from imagent_tpu.resilience import heartbeat
 from imagent_tpu.resilience import exitcodes
 from imagent_tpu.resilience.watchdog import dump_all_stacks
+from imagent_tpu.telemetry import trace as trace_mod  # jax-free
 
 # The active pod-health object engine.run installs; checkpoint.py's
 # collective gates consult it through raise_if_degraded() below so the
@@ -255,6 +256,11 @@ class DeadmanMonitor:
         }
         self.degraded = True
         self._escalate_at = now + self._escalate_window
+        # The detection verdict on the span timeline (monitor thread):
+        # the merged trace shows exactly what every thread was inside
+        # when the peer's staleness crossed the deadline.
+        trace_mod.instant("pod/degraded", cat="pod", peer=int(peer),
+                          reason=reason, stale_for_s=round(age, 3))
         out = self._out if self._out is not None else sys.stderr
         ts = ""
         if tombstone is not None:

@@ -38,6 +38,7 @@ import time
 
 from imagent_tpu.resilience import heartbeat
 from imagent_tpu.telemetry import events as telemetry_events
+from imagent_tpu.telemetry.aggregate import CLOCK_SKEW_WARN_S
 from imagent_tpu.telemetry.events import read_json, write_json_atomic
 
 STATUS_FILENAME = "status.json"
@@ -197,6 +198,18 @@ def render(run_dir: str, now: float | None = None) -> str:
                 f"streak {iw.get('streak', 1)}) — host "
                 f"{iw.get('worst_host', '?')} slowest "
                 f"({_fmt(iw.get('worst_host_wait_s'), '.1f')}s)")
+        skew = st.get("clock_skew_s")
+        if skew is not None:
+            # Measured at the epoch-boundary sync point (the telemetry
+            # allgather) — the one number that says whether cross-rank
+            # wall-clock log reading can be trusted on this pod.
+            line = (f"clock skew: max {_fmt(skew, '.3f')}s across "
+                    "the pod")
+            if float(skew) > CLOCK_SKEW_WARN_S:
+                line += (f"  ** WARN: > {CLOCK_SKEW_WARN_S:g}s — "
+                         "cross-rank log timestamps unreliable; fix "
+                         "NTP (the trace merge corrects for this) **")
+            lines.append(line)
     if epoch_rec is not None:
         phases = epoch_rec.get("phases") or {}
         lines.append(

@@ -19,8 +19,11 @@ the one collective (per-host counter allgather) and all I/O happen in
 
 from __future__ import annotations
 
+import time
+
 from imagent_tpu.telemetry.aggregate import (
-    HOST_FIELDS, allgather_host_stats, flag_stragglers, summarize_hosts,
+    CLOCK_SKEW_WARN_S, HOST_FIELDS, allgather_host_stats, clock_record,
+    flag_stragglers, summarize_hosts,
 )
 from imagent_tpu.telemetry.events import (
     SCHEMA_VERSION, TelemetryWriter, read_events,
@@ -34,13 +37,15 @@ from imagent_tpu.telemetry.profiler import (
     ProfilerSession, hbm_stats, parse_profile_at_step,
 )
 from imagent_tpu.telemetry.sampler import StepTimeSampler
+from imagent_tpu.telemetry import trace as trace_mod
 
 __all__ = [
     "PHASES", "OVERLAP_PHASES", "HOST_FIELDS", "HEALTH_FIELDS",
-    "SCHEMA_VERSION", "GoodputAccountant", "HealthMonitor",
-    "FlightRecorder",
+    "SCHEMA_VERSION", "CLOCK_SKEW_WARN_S", "GoodputAccountant",
+    "HealthMonitor", "FlightRecorder",
     "StepTimeSampler", "TelemetryWriter", "TelemetrySession",
-    "ProfilerSession", "allgather_host_stats", "flag_stragglers",
+    "ProfilerSession", "allgather_host_stats", "clock_record",
+    "flag_stragglers",
     "summarize_hosts", "hbm_stats", "parse_profile_at_step",
     "read_events",
 ]
@@ -120,9 +125,19 @@ class TelemetrySession:
         self._in_epoch = True
 
     def phase(self, name: str, seconds: float) -> None:
-        """Attribute ``seconds`` of the current epoch to a phase."""
+        """Attribute ``seconds`` of the current epoch to a phase.
+
+        The same call doubles as the phase-boundary SPAN emission
+        (``telemetry/trace.py``, cat ``phase``, endpoints ``now -
+        seconds .. now``) — the accountant and the tracer read the one
+        measurement, so the spans-vs-goodput consistency gate cannot
+        drift."""
         if self.enabled and self._in_epoch:
             self.acct.add(name, seconds)
+            if seconds > 0 and trace_mod.active() is not None:
+                t1 = time.perf_counter()
+                trace_mod.complete(name, t1 - seconds, t1,
+                                   cat=trace_mod.PHASE_CAT)
 
     def overlap(self, name: str, seconds: float) -> None:
         """Attribute background work that overlapped the epoch (async
@@ -171,11 +186,27 @@ class TelemetrySession:
 
     # ---- per-step surface (host arithmetic only — no jax) ---------------
 
-    def record_dispatch(self, seconds: float) -> None:
-        """One train-step dispatch returned after ``seconds``."""
+    def record_dispatch(self, seconds: float,
+                        step: int | None = None) -> None:
+        """One train-step dispatch returned after ``seconds``. With a
+        tracer active, the same measurement becomes a ``dispatch`` /
+        ``compile`` phase span: one span per step in ``steps`` mode
+        (tagged with ``step``), coalesced into dispatch WINDOWS in
+        ``phases`` mode (a window breaks at any interleaved span on
+        this thread — a recorded input wait, a compile, a boundary
+        phase)."""
         if self.enabled and self._in_epoch:
-            self.acct.add_dispatch(seconds)
+            phase = self.acct.add_dispatch(seconds)
             self.sampler.mark()
+            rec = trace_mod.active()
+            if rec is not None:
+                t1 = time.perf_counter()
+                if rec.mode == "steps" and step is not None:
+                    rec.complete(phase, t1 - seconds, t1,
+                                 cat=trace_mod.PHASE_CAT, step=step)
+                else:
+                    rec.complete(phase, t1 - seconds, t1,
+                                 cat=trace_mod.PHASE_CAT, merge=True)
 
     def profile_step(self, global_step: int) -> None:
         """Drive the profiler window; called before each dispatch."""
@@ -232,8 +263,13 @@ class TelemetrySession:
             "step_p99_ms": pcts["p99_ms"],
             "h2d_mb": self._h2d_bytes / 1e6,
             "quarantined": self.counters.get("quarantined", 0),
+            # The clock-offset pair, captured immediately before the
+            # shared allgather (aggregate.HOST_FIELDS for semantics).
+            "clock_wall_s": time.time(),
+            "clock_mono_s": time.perf_counter(),
         }
         matrix = allgather_host_stats(local)  # collective (per epoch)
+        clock = clock_record(matrix)
         record = {
             "epoch": int(epoch),
             "wall_s": round(wall, 3),
@@ -249,10 +285,30 @@ class TelemetrySession:
             "counters": {k: round(float(v), 3)
                          for k, v in sorted(self.counters.items())},
             "hbm": hbm_stats(),
+            "clock": clock,
             "interrupted": bool(interrupted),
         }
         if self.health is not None:
             record["health"] = self.health.snapshot()
+        tracer = trace_mod.active()
+        if tracer is not None:
+            # Epoch-boundary trace flush: drains every thread's ring
+            # into trace.<rank>.jsonl and summarizes the chunk (span
+            # count, drops, top names by busy time) into the epoch
+            # record for `telemetry summarize`.
+            record["trace"] = tracer.flush()
+        if (self.is_master and clock["max_skew_s"] > CLOCK_SKEW_WARN_S
+                and matrix.shape[0] > 1):
+            wall_col = matrix[:, HOST_FIELDS.index("clock_wall_s")]
+            print(f"WARNING: pod wall-clock skew "
+                  f"{clock['max_skew_s']:.1f}s (host "
+                  f"{int(wall_col.argmax())} fastest clock, host "
+                  f"{int(wall_col.argmin())} slowest, measured at the "
+                  "epoch-boundary sync point) — cross-rank log "
+                  "timestamps are unreliable; fix NTP on the pod. The "
+                  "trace merge corrects for this "
+                  "(docs/OPERATIONS.md 'Reading a pod trace')",
+                  flush=True)
         # Input-wait alerting (ROADMAP item 5's alerting clause): the
         # fraction is an epoch-long average, so one offending epoch IS
         # sustained starvation, not a burst; the streak counts how long

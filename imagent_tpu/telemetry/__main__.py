@@ -6,7 +6,16 @@ Subcommands:
   from ``runs/<run>/telemetry.jsonl`` (the torn-tail-tolerant reader
   in ``events.py``), plus the run header and any anomaly/degraded
   events.  Resume semantics match ``benchmarks/render_curves.py``: a
-  resumed run appends, so the LAST record per epoch wins.
+  resumed run appends, so the LAST record per epoch wins.  Runs traced
+  with ``--trace`` grow a trace column set (span counts + the top-3
+  span names by total busy time per epoch) so a bad goodput epoch can
+  be explained without opening Perfetto.
+* ``trace <run_dir>`` — merge the per-rank ``trace/trace.<rank>.jsonl``
+  span files into one skew-corrected Chrome-trace-format
+  ``trace/trace.json`` (pid = rank, tid = thread) that loads in
+  Perfetto, validated against the trace event schema before it is
+  written.  ``--top N`` additionally prints the N longest spans as
+  text (docs/OPERATIONS.md "Reading a pod trace").
 
 Pure JSONL post-processing — runs on any box with no accelerator
 stack (nothing here imports jax).  The exact table format is pinned by
@@ -20,11 +29,14 @@ import argparse
 import os
 import sys
 
+from imagent_tpu.telemetry import trace as trace_lib
 from imagent_tpu.telemetry.events import FILENAME, read_events
 
 _COLUMNS = ("epoch", "wall_s", "goodput", "input_s", "p95_ms",
             "bad", "anomal", "gnorm_ewma", "ratio_ewma", "hbm_gb")
 _WIDTHS = (5, 8, 7, 8, 8, 4, 6, 10, 10, 7)
+_TRACE_COLUMNS = ("spans", "drop")
+_TRACE_WIDTHS = (7, 5)
 
 
 def _cell(v, width: int, spec: str = "") -> str:
@@ -62,6 +74,15 @@ def summarize(run_dir: str) -> str:
                 f"  pod_degraded: peer {rec.get('peer')} "
                 f"({rec.get('reason')}) at epoch "
                 f"{int(rec.get('epoch', 0)) + 1}")
+    # The trace columns appear only when the run was traced — an
+    # untraced run's table stays byte-identical to the pre-trace
+    # format (both pinned by golden tests).
+    has_trace = any(isinstance(rec.get("trace"), dict)
+                    for rec in by_epoch.values())
+    columns, widths = _COLUMNS, _WIDTHS
+    if has_trace:
+        columns = _COLUMNS + _TRACE_COLUMNS
+        widths = _WIDTHS + _TRACE_WIDTHS
     lines = []
     if run_start is not None:
         lines.append(
@@ -70,7 +91,7 @@ def summarize(run_dir: str) -> str:
             f"{run_start.get('process_count', '?')} host(s), "
             f"{run_start.get('steps_per_epoch', '?')} steps/epoch")
     lines.append("  ".join(c.rjust(w)
-                           for c, w in zip(_COLUMNS, _WIDTHS)))
+                           for c, w in zip(columns, widths)))
     for epoch in sorted(by_epoch):
         rec = by_epoch[epoch]
         phases = rec.get("phases") or {}
@@ -78,7 +99,7 @@ def summarize(run_dir: str) -> str:
         health = rec.get("health") or {}
         hbm = rec.get("hbm") or {}
         peak = hbm.get("peak_bytes_in_use")
-        cells = (
+        cells = [
             _cell(epoch + 1, _WIDTHS[0], "d"),
             _cell(rec.get("wall_s"), _WIDTHS[1], ".1f"),
             _cell(rec.get("goodput"), _WIDTHS[2], ".3f"),
@@ -92,12 +113,26 @@ def summarize(run_dir: str) -> str:
             _cell(health.get("update_ratio_ewma"), _WIDTHS[8], ".3g"),
             _cell(None if peak is None else peak / 1e9,
                   _WIDTHS[9], ".2f"),
-        )
+        ]
+        tr = rec.get("trace") if isinstance(rec.get("trace"), dict) \
+            else None
+        if has_trace:
+            cells.append(_cell(None if tr is None else
+                               int(tr.get("spans", 0)),
+                               _TRACE_WIDTHS[0], "d"))
+            cells.append(_cell(None if tr is None else
+                               int(tr.get("dropped", 0)),
+                               _TRACE_WIDTHS[1], "d"))
         flags = ""
         if rec.get("interrupted"):
             flags += "  [interrupted]"
         if rec.get("stragglers"):
             flags += f"  [stragglers: {len(rec['stragglers'])}]"
+        if tr is not None and tr.get("top"):
+            # The per-epoch "where did the spans go" answer: top span
+            # names by total busy seconds, widest first.
+            flags += "  top[" + ", ".join(
+                f"{name} {secs:.1f}s" for name, secs in tr["top"]) + "]"
         lines.append("  ".join(cells) + flags)
     lines.extend(notable)
     if run_end is not None:
@@ -109,18 +144,70 @@ def summarize(run_dir: str) -> str:
     return "\n".join(lines)
 
 
+def merge_trace(run_dir: str, out: str | None, top: int) -> int:
+    """The ``trace`` subcommand body: merge, validate, write, report."""
+    try:
+        obj = trace_lib.merge(run_dir)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    errs = trace_lib.validate_chrome_trace(obj)
+    if errs:
+        # A merge that fails its own schema check must not ship a file
+        # Perfetto will choke on.
+        print("merged trace FAILED Chrome-trace validation:",
+              file=sys.stderr)
+        for err in errs[:10]:
+            print(f"  {err}", file=sys.stderr)
+        return 1
+    out_path = trace_lib.write_merged(run_dir, out, obj=obj)
+    other = obj.get("otherData", {})
+    n_events = sum(1 for ev in obj["traceEvents"]
+                   if ev.get("ph") != "M")
+    uncorrected = [r for r, ok in
+                   sorted(other.get("skew_corrected", {}).items())
+                   if not ok]
+    print(f"merged {n_events} span events from ranks "
+          f"{other.get('ranks')} -> {out_path} "
+          f"(open in https://ui.perfetto.dev)")
+    print(f"clock skew: max {other.get('max_skew_s', 0.0)}s across the "
+          f"pod (per-rank {other.get('skews_s')}; corrected to rank "
+          f"{other.get('ref_rank')}'s clock via the epoch-boundary "
+          "sync point)")
+    if uncorrected:
+        print(f"WARNING: ranks {uncorrected} had no telemetry clock "
+              "record (run killed before an epoch boundary?) — their "
+              "spans are placed on their own wall clock, UNcorrected "
+              "for skew")
+    if top > 0:
+        print(trace_lib.top_spans_text(obj, top))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m imagent_tpu.telemetry",
-        description="Offline telemetry.jsonl tooling")
+        description="Offline telemetry.jsonl / trace tooling")
     sub = p.add_subparsers(dest="cmd", required=True)
     ps = sub.add_parser("summarize",
                         help="per-epoch goodput/health table")
     ps.add_argument("run_dir", help="the run's --log-dir")
+    pt = sub.add_parser(
+        "trace",
+        help="merge per-rank trace files into a skew-corrected "
+             "Perfetto-loadable trace.json")
+    pt.add_argument("run_dir", help="the run's --log-dir")
+    pt.add_argument("--out", default=None,
+                    help="output path (default "
+                         "<run_dir>/trace/trace.json)")
+    pt.add_argument("--top", type=int, default=0, metavar="N",
+                    help="also print the N longest spans as text")
     ns = p.parse_args(argv)
     if ns.cmd == "summarize":
         print(summarize(ns.run_dir), flush=True)
         return 0
+    if ns.cmd == "trace":
+        return merge_trace(ns.run_dir, ns.out, ns.top)
     return 2  # unreachable: argparse enforces the subcommand
 
 

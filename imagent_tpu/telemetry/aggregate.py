@@ -29,7 +29,23 @@ HOST_FIELDS = (
     "step_p99_ms",
     "h2d_mb",         # host→device wire megabytes staged
     "quarantined",    # undecodable inputs zero-filled this epoch
+    # Host clock-offset pair, captured as each host packs its vector:
+    # the allgather is a SHARED EVENT all hosts reach within the
+    # collective's arrival spread, so the wall column measures pod
+    # wall-clock skew directly (max - min) and the (mono, wall) pair
+    # maps each rank's monotonic span timestamps (telemetry/trace.py)
+    # onto one common timeline. Rides the existing once-per-epoch
+    # collective — zero new collectives.
+    "clock_wall_s",   # time.time() at vector-pack
+    "clock_mono_s",   # time.perf_counter() at the same instant
 )
+
+# Pod wall-clock skew above this gets a master WARN and a status.json
+# flag: skewed clocks make cross-rank log reading (and any tooling
+# that joins per-host logs on wall time) actively misleading. The
+# measurement includes the epoch-boundary arrival spread, so the
+# threshold is set above normal boundary jitter.
+CLOCK_SKEW_WARN_S = 1.0
 
 # Metrics the straggler rule inspects, with their absolute floors: a
 # host below the floor is never flagged however small the pod median.
@@ -68,6 +84,19 @@ def allgather_host_stats(local: dict) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(vec),
                       np.float64).reshape(jax.process_count(),
                                           len(HOST_FIELDS))
+
+
+def clock_record(matrix: np.ndarray) -> dict:
+    """The per-epoch clock record the trace merge reads (one slot per
+    rank, allgather row order): the (wall, mono) pairs plus the pod's
+    max wall-clock skew, measured at the shared allgather event."""
+    wall = matrix[:, HOST_FIELDS.index("clock_wall_s")]
+    mono = matrix[:, HOST_FIELDS.index("clock_mono_s")]
+    return {
+        "wall": [round(float(x), 6) for x in wall],
+        "mono": [round(float(x), 6) for x in mono],
+        "max_skew_s": round(float(wall.max() - wall.min()), 6),
+    }
 
 
 def summarize_hosts(matrix: np.ndarray) -> dict:

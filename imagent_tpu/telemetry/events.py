@@ -24,10 +24,12 @@ Event types:
   ``TelemetrySession.pod_degraded``).
 * ``run_end``    — run summary totals.
 
-Schema note: the ``health`` sub-record and the two event types above
-are ADDITIONS (consumers ignore unknown keys/events), not a
-``SCHEMA_VERSION`` bump — a bump would make old readers drop every
-record.  ``python -m imagent_tpu.telemetry summarize <run_dir>`` is
+Schema note: the ``health`` sub-record, the two event types above, and
+the ``clock`` (per-rank wall/mono pairs + max pod skew, from the epoch
+allgather) and ``trace`` (pod-tracer span counts/drops + top span
+names, ``telemetry/trace.py``) sub-records are ADDITIONS (consumers
+ignore unknown keys/events), not a ``SCHEMA_VERSION`` bump — a bump
+would make old readers drop every record.  ``python -m imagent_tpu.telemetry summarize <run_dir>`` is
 the offline reader for the whole log.
 
 Every record carries ``{"event": <type>, "schema": SCHEMA_VERSION,

@@ -1,0 +1,738 @@
+"""Pod tracer: cross-host span timeline with Perfetto export.
+
+The goodput accountant says *how much* of an epoch each phase cost;
+the pod aggregation says *which host* dragged; neither can say **when,
+on which thread, overlapping what** a slow event actually happened —
+once ``pod/straggler`` or ``input_wait_alert`` fires, nothing in the
+system can show the shape of the stall. This module can: a span
+recorder every subsystem emits into, per-rank span files, and an
+offline merge into one Chrome-trace-format timeline that loads in
+Perfetto (``python -m imagent_tpu.telemetry trace <run_dir>``).
+
+Recorder contract (the ``sampler.py`` discipline — this module is on
+the per-step path and on the fatal exit ramps, so it stays
+**jax-free**, asserted by ``tests/test_trace.py``):
+
+* ``span("name")`` / ``complete(name, t0, t1)`` / ``instant(name)``
+  cost two host timestamps and one slot store — no I/O, no device
+  handles, no locks beyond the emitting thread's own ring lock.
+* One bounded ring per thread (``--trace-buffer`` spans each); the
+  ring drops its OLDEST span on overflow and counts the drop — a
+  chatty subsystem can cost trace coverage, never memory.
+* ``--trace off`` (the default) means NO recorder exists: the
+  module-level emitters read one global and return a shared no-op —
+  zero files, zero rings, zero per-span allocation.
+* Phase-boundary spans are emitted BY the telemetry session at the
+  same call sites that feed the goodput accountant
+  (``TelemetrySession.phase`` / ``record_dispatch``), so the two
+  systems cannot drift: the bench-smoke gate asserts traced phase
+  spans sum to within tolerance of the accountant's phases.
+* In ``phases`` mode, adjacent same-name spans on a thread coalesce
+  into one WINDOW span (``k`` occurrences, ``b`` = busy seconds — the
+  sum of the merged durations, which is what the consistency gate
+  reads; the window's ``t1 - t0`` additionally covers the gaps).
+  ``steps`` mode records every dispatch individually.
+
+Flush discipline: rings are drained and appended to
+``<log_dir>/trace/trace.<rank>.jsonl`` in ONE ``write`` call at every
+epoch boundary (``TelemetrySession.epoch_end``) and — with fsync — on
+every fatal exit ramp (the flight-recorder flush path: ``engine.run``
+handlers, the watchdog-86 escalation, the deadman-87 ``on_fatal``
+hook). A kill mid-write can tear at most the trailing line, which the
+reader skips (``read_trace``); everything earlier is intact.
+
+Clock-skew correction: spans carry ``time.perf_counter()`` timestamps
+(monotonic — wall-clock steps cannot tear a span). Each host's
+mapping to a COMMON timeline comes from the once-per-epoch telemetry
+allgather (``aggregate.HOST_FIELDS``), which now carries a
+(perf_counter, wall) pair captured as each host packs its vector: the
+allgather is a shared event all hosts reach within the collective's
+arrival spread, so rank r's span at monotonic ``t`` lands at
+``wall_ref + (t - mono_r)`` on the reference rank's wall clock — raw
+NTP-class skew (seconds-to-minutes on misconfigured fleets) cancels
+entirely, leaving only the boundary-arrival spread (the straggler
+gap). The residual skew per rank and the pod max are reported in the
+merge metadata, the epoch record (``clock``), and ``status.json``.
+Without a telemetry clock record (e.g. a run killed before its first
+epoch boundary) the merge falls back to each file's own header pair:
+correct per-rank placement, NO cross-rank correction — flagged in the
+output.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from imagent_tpu.telemetry.events import jsonsafe
+
+SCHEMA_VERSION = 1
+TRACE_DIRNAME = "trace"
+FILENAME_FMT = "trace.{rank}.jsonl"
+MERGED_FILENAME = "trace.json"
+
+MODES = ("off", "phases", "steps")
+DEFAULT_BUFFER = 4096
+
+# Category of the spans that mirror the goodput accountant's phase
+# taxonomy — the only spans the consistency gate sums.
+PHASE_CAT = "phase"
+
+# Queue waits shorter than this are scheduler noise, not stalls; they
+# stay in the accountant's input_wait total but get no span (the 5%
+# consistency tolerance absorbs the difference).
+MIN_WAIT_SPAN_S = 1e-3
+
+_ACTIVE: "TraceRecorder | None" = None
+
+
+def trace_dir(log_dir: str) -> str:
+    return os.path.join(log_dir, TRACE_DIRNAME)
+
+
+def trace_path(log_dir: str, rank: int) -> str:
+    return os.path.join(trace_dir(log_dir),
+                        FILENAME_FMT.format(rank=int(rank)))
+
+
+# ---------------------------------------------------------------------------
+# Module-level emitters (the no-plumbing surface subsystems call)
+# ---------------------------------------------------------------------------
+
+
+class _NullSpan:
+    """The shared do-nothing context manager ``--trace off`` costs."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NULL = _NullSpan()
+
+
+def activate(rec: "TraceRecorder | None") -> None:
+    """Install ``rec`` as the process-global recorder the module-level
+    emitters write into (the ``deadman._ACTIVE`` pattern: checkpoint
+    committer threads, prefetch producers, and the offload client all
+    emit without a handle being plumbed to them)."""
+    global _ACTIVE
+    _ACTIVE = rec
+
+
+def deactivate() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> "TraceRecorder | None":
+    return _ACTIVE
+
+
+def span(name: str, cat: str = "", **attrs):
+    """Context manager timing a block; no-op (shared object, zero
+    allocation) when no recorder is active."""
+    rec = _ACTIVE
+    if rec is None:
+        return _NULL
+    return rec.span(name, cat=cat, **attrs)
+
+
+def complete(name: str, t0: float, t1: float, cat: str = "",
+             merge: bool = False, **attrs) -> None:
+    """Record an already-timed span (``time.perf_counter()``
+    endpoints). ``merge``: in ``phases`` mode, coalesce into the
+    previous span on this thread when it has the same name/cat."""
+    rec = _ACTIVE
+    if rec is not None:
+        rec.complete(name, t0, t1, cat=cat, merge=merge, **attrs)
+
+
+def instant(name: str, cat: str = "", **attrs) -> None:
+    rec = _ACTIVE
+    if rec is not None:
+        rec.instant(name, cat=cat, **attrs)
+
+
+def flush_active(fsync: bool = False) -> dict | None:
+    """Flush the active recorder (fatal exit ramps; no-op → None)."""
+    rec = _ACTIVE
+    return rec.flush(fsync=fsync) if rec is not None else None
+
+
+def close_active() -> None:
+    """Final flush + deactivate (the engine's ``finally``)."""
+    global _ACTIVE
+    rec = _ACTIVE
+    _ACTIVE = None
+    if rec is not None:
+        rec.flush()
+
+
+# ---------------------------------------------------------------------------
+# Recorder
+# ---------------------------------------------------------------------------
+
+
+class _Span:
+    __slots__ = ("name", "cat", "ph", "t0", "t1", "busy", "k", "attrs")
+
+    def __init__(self, name, cat, ph, t0, t1, attrs):
+        self.name = name
+        self.cat = cat
+        self.ph = ph          # "X" complete | "i" instant
+        self.t0 = t0
+        self.t1 = t1
+        self.busy = t1 - t0   # merged spans: sum of merged durations
+        self.k = 1            # merged spans: occurrence count
+        self.attrs = attrs
+
+
+class _Ring:
+    """One thread's bounded span buffer. Only its owner thread appends;
+    the flusher drains under the same small lock."""
+
+    __slots__ = ("spans", "lock", "tid", "tname", "thread", "dropped")
+
+    def __init__(self, capacity: int, thread: threading.Thread):
+        import collections
+        self.spans: "collections.deque[_Span]" = \
+            collections.deque(maxlen=capacity)
+        self.lock = threading.Lock()
+        self.tid = int(thread.ident or 0)
+        self.tname = thread.name
+        self.thread = thread
+        self.dropped = 0
+
+
+class _SpanCtx:
+    __slots__ = ("_rec", "_name", "_cat", "_attrs", "_t0")
+
+    def __init__(self, rec, name, cat, attrs):
+        self._rec = rec
+        self._name = name
+        self._cat = cat
+        self._attrs = attrs
+
+    def set(self, **attrs) -> None:
+        self._attrs.update(attrs)
+
+    def __enter__(self) -> "_SpanCtx":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, et, ev, tb) -> bool:
+        if et is not None:
+            self._attrs.setdefault("error", et.__name__)
+        self._rec.complete(self._name, self._t0, time.perf_counter(),
+                           cat=self._cat, **self._attrs)
+        return False
+
+
+class TraceRecorder:
+    """Thread-aware bounded span recorder + the per-rank flush."""
+
+    def __init__(self, log_dir: str, rank: int = 0,
+                 mode: str = "phases", buffer: int = DEFAULT_BUFFER):
+        if mode not in MODES or mode == "off":
+            raise ValueError(f"trace mode must be phases|steps, "
+                             f"got {mode!r}")
+        if buffer < 1:
+            raise ValueError("trace buffer must be >= 1")
+        self.path = trace_path(log_dir, rank)
+        self.rank = int(rank)
+        self.mode = mode
+        self.buffer = int(buffer)
+        self._rings: list[_Ring] = []
+        self._rings_lock = threading.Lock()
+        self._local = threading.local()
+        # Fatal ramps (watchdog/deadman threads) race the main thread's
+        # flushes by design — serialize like the flight recorder.
+        self._flush_lock = threading.Lock()
+        self._wrote_header = False
+        self._write_warned = False
+        self.spans_flushed = 0
+        self.dropped_total = 0
+
+    # ---- recording (hot path) -------------------------------------------
+
+    def _ring(self) -> _Ring:
+        r = getattr(self._local, "ring", None)
+        if r is None:
+            r = _Ring(self.buffer, threading.current_thread())
+            with self._rings_lock:
+                self._rings.append(r)
+            self._local.ring = r
+        return r
+
+    def complete(self, name: str, t0: float, t1: float, cat: str = "",
+                 merge: bool = False, **attrs) -> None:
+        ring = self._ring()
+        with ring.lock:
+            if merge and self.mode != "steps" and ring.spans:
+                last = ring.spans[-1]
+                # Coalesce only into the IMMEDIATELY previous span: any
+                # other span emitted in between ends the window.
+                if (last.ph == "X" and last.name == name
+                        and last.cat == cat):
+                    last.t1 = t1
+                    last.busy += t1 - t0
+                    last.k += 1
+                    return
+            if len(ring.spans) == ring.spans.maxlen:
+                ring.dropped += 1
+            ring.spans.append(_Span(name, cat, "X", t0, t1,
+                                    attrs or None))
+
+    def instant(self, name: str, cat: str = "", **attrs) -> None:
+        ring = self._ring()
+        now = time.perf_counter()
+        with ring.lock:
+            if len(ring.spans) == ring.spans.maxlen:
+                ring.dropped += 1
+            ring.spans.append(_Span(name, cat, "i", now, now,
+                                    attrs or None))
+
+    def span(self, name: str, cat: str = "", **attrs) -> _SpanCtx:
+        return _SpanCtx(self, name, cat, dict(attrs))
+
+    # ---- flush -----------------------------------------------------------
+
+    def flush(self, fsync: bool = False) -> dict:
+        """Drain every thread's ring and append the chunk to the
+        per-rank file in one write. Returns the chunk summary
+        ``{"spans", "dropped", "top"}`` (top-3 span names by total busy
+        seconds) — the per-epoch ``trace`` record ``summarize`` reads."""
+        with self._flush_lock:
+            return self._flush_locked(fsync)
+
+    def _flush_locked(self, fsync: bool) -> dict:
+        with self._rings_lock:
+            rings = list(self._rings)
+        drained: list[tuple[_Ring, list, int]] = []
+        for ring in rings:
+            with ring.lock:
+                spans = list(ring.spans)
+                ring.spans.clear()
+                dropped, ring.dropped = ring.dropped, 0
+            drained.append((ring, spans, dropped))
+            if not spans and not ring.thread.is_alive():
+                # A finished worker thread's empty ring (one committer
+                # thread per async save) must not accumulate forever.
+                with self._rings_lock:
+                    if ring in self._rings:
+                        self._rings.remove(ring)
+        lines: list[str] = []
+        if not self._wrote_header:
+            # The per-file (mono, wall) pair is the merge's FALLBACK
+            # mapping when no telemetry clock record exists — per-rank
+            # placement only, no cross-rank skew correction.
+            lines.append(json.dumps(
+                {"event": "header", "schema": SCHEMA_VERSION,
+                 "rank": self.rank, "pid": os.getpid(),
+                 "mode": self.mode,
+                 "clock": {"mono": time.perf_counter(),
+                           "wall": time.time()}}, sort_keys=True))
+        n_spans, n_dropped = 0, 0
+        busy_by_name: dict[str, float] = {}
+        for ring, spans, dropped in drained:
+            n_dropped += dropped
+            for sp in spans:
+                n_spans += 1
+                busy_by_name[sp.name] = \
+                    busy_by_name.get(sp.name, 0.0) + sp.busy
+                row = {"n": sp.name, "ph": sp.ph,
+                       "t0": round(sp.t0, 7), "t1": round(sp.t1, 7),
+                       "tid": ring.tid, "tn": ring.tname}
+                if sp.cat:
+                    row["c"] = sp.cat
+                if sp.k > 1:
+                    row["k"] = sp.k
+                    row["b"] = round(sp.busy, 7)
+                if sp.attrs:
+                    row["a"] = jsonsafe(sp.attrs)
+                lines.append(json.dumps(row, sort_keys=True))
+        summary = {
+            "spans": n_spans, "dropped": n_dropped,
+            "top": [[name, round(secs, 3)] for name, secs in
+                    sorted(busy_by_name.items(),
+                           key=lambda kv: (-kv[1], kv[0]))[:3]],
+        }
+        if not lines:
+            return summary
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".",
+                        exist_ok=True)
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write("\n".join(lines) + "\n")
+                if fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            self._wrote_header = True
+            self.spans_flushed += n_spans
+            self.dropped_total += n_dropped
+        except OSError as e:
+            # Advisory surface: storage flaking must not touch the run.
+            if not self._write_warned:
+                self._write_warned = True
+                print(f"WARNING: trace flush failed ({e}); the span "
+                      "timeline is incomplete", flush=True)
+        return summary
+
+
+# ---------------------------------------------------------------------------
+# Reader + merge (offline; no recorder required)
+# ---------------------------------------------------------------------------
+
+
+def read_trace_segments(path: str
+                        ) -> list[tuple[dict | None, list[dict]]]:
+    """Parse one per-rank trace file into ATTEMPT segments:
+    ``[(header, spans)]``. A requeued/resumed run APPENDS to the same
+    file, and each process writes its own header on its first flush —
+    so each segment's spans belong to one process/boot and must be
+    placed with THAT segment's clock pair (monotonic origins differ
+    per boot; mapping an old attempt's spans through a newer clock
+    would misplace them by hours). Tolerant of a torn trailing line
+    (a kill racing the append) and of unknown future fields; spans
+    before any parseable header land in a header-``None`` segment."""
+    segments: list[tuple[dict | None, list[dict]]] = []
+    header: dict | None = None
+    spans: list[dict] = []
+    started = False
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail from a killed run
+            if not isinstance(rec, dict):
+                continue
+            if rec.get("event") == "header":
+                if rec.get("schema", 0) > SCHEMA_VERSION:
+                    continue
+                if started:
+                    segments.append((header, spans))
+                header, spans, started = rec, [], True
+            elif "t0" in rec and "n" in rec:
+                spans.append(rec)
+                started = True
+    if started:
+        segments.append((header, spans))
+    return segments
+
+
+def read_trace(path: str) -> tuple[dict | None, list[dict]]:
+    """Flat view of one per-rank trace file → ``(first header, all
+    spans)`` — for callers that only need names/attrs. Placement-aware
+    callers (the merge) use :func:`read_trace_segments`."""
+    segments = read_trace_segments(path)
+    header = next((h for h, _s in segments if h is not None), None)
+    return header, [sp for _h, sps in segments for sp in sps]
+
+
+def _rank_files(run_dir: str) -> list[tuple[int, str]]:
+    d = trace_dir(run_dir)
+    out = []
+    try:
+        entries = os.listdir(d)
+    except OSError:
+        return out
+    for entry in entries:
+        parts = entry.split(".")
+        if (len(parts) == 3 and parts[0] == "trace"
+                and parts[2] == "jsonl" and parts[1].isdigit()):
+            out.append((int(parts[1]), os.path.join(d, entry)))
+    out.sort()
+    return out
+
+
+def load_run_traces(run_dir: str
+                    ) -> list[tuple[int, dict | None, list[dict]]]:
+    """Every per-rank trace file under ``<run_dir>/trace/``, sorted by
+    rank — ``[(rank, first_header, all_spans)]``."""
+    return [(rank, *read_trace(path))
+            for rank, path in _rank_files(run_dir)]
+
+
+def load_clock(run_dir: str) -> dict | None:
+    """The newest per-epoch clock record ``{"wall": [...], "mono":
+    [...]}`` from ``telemetry.jsonl`` (one slot per rank, allgather row
+    order) — the shared-event mapping the skew correction rides."""
+    from imagent_tpu.telemetry.events import FILENAME, read_events
+    path = os.path.join(run_dir, FILENAME)
+    if not os.path.isfile(path):
+        return None
+    clock = None
+    for rec in read_events(path):
+        if rec.get("event") == "epoch" and isinstance(
+                rec.get("clock"), dict):
+            c = rec["clock"]
+            if isinstance(c.get("wall"), list) and \
+                    isinstance(c.get("mono"), list):
+                clock = {"wall": [float(x) for x in c["wall"]],
+                         "mono": [float(x) for x in c["mono"]]}
+    return clock
+
+
+def phase_span_seconds(spans: list[dict]) -> dict[str, float]:
+    """Busy seconds per phase name over the ``cat == "phase"`` spans —
+    the traced side of the spans-vs-goodput consistency gate (merged
+    window spans contribute their ``b`` busy total, not the window
+    extent, so coalescing never inflates the sum)."""
+    out: dict[str, float] = {}
+    for sp in spans:
+        if sp.get("c") != PHASE_CAT or sp.get("ph") != "X":
+            continue
+        busy = float(sp.get("b", sp["t1"] - sp["t0"]))
+        out[sp["n"]] = out.get(sp["n"], 0.0) + busy
+    return out
+
+
+def merge(run_dir: str) -> dict:
+    """Merge the per-rank span files into one Chrome-trace-format
+    object (pid = rank, tid = per-rank thread index) on a single
+    skew-corrected timeline. Raises ``FileNotFoundError`` when the run
+    has no trace files.
+
+    Placement: each ATTEMPT segment's spans map onto the host's own
+    wall clock via that segment's header (mono, wall) pair — monotonic
+    origins are per-boot, but the wall clock is continuous across
+    requeues, so a resumed run's earlier attempts land where they
+    belong. Skew correction then SHIFTS each rank onto the reference
+    rank's wall clock by the skew measured at the shared allgather
+    event (``load_clock``); a host's NTP skew is stable on the run's
+    timescale, so one measured shift corrects every attempt. Spans
+    with no header at all (orphaned by a torn first line) fall back to
+    the rank's allgather pair; with neither, the rank is placed on its
+    own relative clock and flagged uncorrected.
+
+    Determinism: files are processed in rank order, per-rank thread
+    ids are remapped to stable small ints (by thread name, then raw
+    id — the pair, because the OS recycles raw idents across
+    short-lived committer threads), events are globally sorted, and
+    the JSON the CLI writes uses sorted keys — byte-identical output
+    however the files were written or listed."""
+    files = _rank_files(run_dir)
+    if not files:
+        raise FileNotFoundError(
+            f"no trace files under {trace_dir(run_dir)} — was the run "
+            "started with --trace phases|steps?")
+    clock = load_clock(run_dir)
+    ranks_with_clock = [] if clock is None else \
+        [r for r, _p in files if r < len(clock["wall"])]
+    # Reference rank for the common timeline: rank 0 when its clock
+    # slot exists, else the lowest rank with one.
+    ref = None
+    if ranks_with_clock:
+        ref = 0 if 0 in ranks_with_clock else ranks_with_clock[0]
+    skews: dict[int, float] = {}
+    corrected: dict[int, bool] = {}
+    attempts: dict[int, int] = {}
+    placed: list[tuple[int, float, dict]] = []  # (rank, t_wall, span)
+    dropped_unplaceable = 0
+    for rank, path in files:
+        segments = read_trace_segments(path)
+        attempts[rank] = sum(1 for h, _s in segments if h is not None)
+        if ref is not None and rank in ranks_with_clock:
+            skews[rank] = clock["wall"][rank] - clock["wall"][ref]
+            corrected[rank] = True
+        else:
+            corrected[rank] = False
+        shift = skews.get(rank, 0.0)
+        for header, spans in segments:
+            if header is not None and \
+                    isinstance(header.get("clock"), dict):
+                wall0 = float(header["clock"]["wall"])
+                mono0 = float(header["clock"]["mono"])
+            elif rank in ranks_with_clock:
+                # Orphan segment (torn header): the allgather pair is
+                # consistent only with the attempt that produced it —
+                # the best remaining guess.
+                wall0, mono0 = clock["wall"][rank], clock["mono"][rank]
+            elif len(segments) == 1:
+                wall0, mono0 = 0.0, 0.0  # relative placement only
+            else:
+                # Multiple attempts, no header, no clock: these spans
+                # cannot be placed relative to the other segments.
+                dropped_unplaceable += len(spans)
+                continue
+            for sp in spans:
+                placed.append(
+                    (rank, wall0 + (float(sp["t0"]) - mono0) - shift,
+                     sp))
+    # Rebase to the earliest event so Perfetto opens at t=0.
+    base = min((t for _r, t, _sp in placed), default=0.0)
+    events: list[dict] = []
+    tid_of: dict[tuple[int, str, int], int] = {}
+    for rank, _path in files:
+        # Stable small tids per rank, by (thread name, raw id) — the
+        # PAIR, so a recycled raw ident under a new thread name gets
+        # its own row instead of stealing an old one's.
+        keys = sorted({(sp.get("tn", "?"), int(sp.get("tid", 0)))
+                       for r, _t, sp in placed if r == rank})
+        events.append({"ph": "M", "name": "process_name", "pid": rank,
+                       "tid": 0, "args": {"name": f"rank {rank}"}})
+        events.append({"ph": "M", "name": "process_sort_index",
+                       "pid": rank, "tid": 0,
+                       "args": {"sort_index": rank}})
+        for i, (tname, raw) in enumerate(keys):
+            tid_of[(rank, tname, raw)] = i
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": rank, "tid": i,
+                           "args": {"name": tname}})
+    for rank, t_wall, sp in placed:
+        ev = {"name": sp["n"], "cat": sp.get("c") or "span",
+              "pid": rank,
+              "tid": tid_of[(rank, sp.get("tn", "?"),
+                             int(sp.get("tid", 0)))],
+              "ts": round((t_wall - base) * 1e6, 3)}
+        args = dict(sp.get("a") or {})
+        if sp.get("ph") == "i":
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        else:
+            ev["ph"] = "X"
+            ev["dur"] = round((float(sp["t1"]) - float(sp["t0"]))
+                              * 1e6, 3)
+            if sp.get("k", 1) > 1:
+                args["count"] = int(sp["k"])
+                args["busy_ms"] = round(float(sp["b"]) * 1e3, 3)
+        if args:
+            ev["args"] = jsonsafe(args)
+        events.append(ev)
+    events.sort(key=lambda e: (e["ph"] != "M", e.get("ts", 0.0),
+                               e["pid"], e["tid"], e["name"]))
+    wall_skews = list(skews.values())
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "ranks": [r for r, _p in files],
+            "ref_rank": ref,
+            "attempts": {str(r): n for r, n in sorted(attempts.items())},
+            "skew_corrected": {str(r): corrected[r]
+                               for r, _p in files},
+            "skews_s": {str(r): round(s, 6)
+                        for r, s in sorted(skews.items())},
+            "max_skew_s": (round(max(wall_skews) - min(wall_skews), 6)
+                           if wall_skews else 0.0),
+            "dropped_unplaceable": dropped_unplaceable,
+        },
+    }
+
+
+def write_merged(run_dir: str, out_path: str | None = None,
+                 obj: dict | None = None) -> str:
+    """Write ``trace.json`` (sorted keys — deterministic bytes);
+    merges unless the caller passes an already-built ``obj`` (the CLI
+    and the bench gate validate first, then write the SAME object).
+    Returns the output path."""
+    if obj is None:
+        obj = merge(run_dir)
+    out_path = out_path or os.path.join(trace_dir(run_dir),
+                                        MERGED_FILENAME)
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    tmp = f"{out_path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f, sort_keys=True)
+    os.replace(tmp, out_path)
+    return out_path
+
+
+def top_spans_text(obj: dict, n: int = 10) -> str:
+    """The ``--top N`` text mode: the longest spans in the merged
+    timeline (name, rank, thread, start, duration) — names the slow
+    events on the straggler host without opening Perfetto. Coalesced
+    window spans rank by their BUSY time (``args.busy_ms``), not the
+    window extent — an epoch-long window of µs dispatches must not
+    outrank a single multi-second stall."""
+
+    def busy_ms(ev) -> float:
+        args = ev.get("args") or {}
+        return float(args.get("busy_ms", ev.get("dur", 0.0) / 1e3))
+
+    tnames: dict[tuple[int, int], str] = {}
+    xs = []
+    for ev in obj.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            tnames[(ev["pid"], ev["tid"])] = \
+                (ev.get("args") or {}).get("name", "?")
+        elif ev.get("ph") == "X":
+            xs.append(ev)
+    xs.sort(key=lambda e: (-busy_ms(e), e.get("ts", 0.0),
+                           e["pid"], e["tid"], e["name"]))
+    lines = [f"{'busy_ms':>10}  {'start_ms':>10}  rank  "
+             f"{'thread':<20}  span"]
+    for ev in xs[: max(n, 0)]:
+        count = (ev.get("args") or {}).get("count")
+        name = ev["name"] + (f"  [window of {count}]" if count else "")
+        lines.append(
+            f"{busy_ms(ev):>10.3f}  {ev['ts'] / 1e3:>10.1f}  "
+            f"{ev['pid']:>4}  "
+            f"{tnames.get((ev['pid'], ev['tid']), '?'):<20}  "
+            f"{name}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace event schema validation
+# ---------------------------------------------------------------------------
+
+_PH_ALLOWED = {"X", "i", "I", "M", "B", "E"}
+_INSTANT_SCOPES = {"t", "p", "g"}
+
+
+def validate_chrome_trace(obj) -> list[str]:
+    """Structural validation against the Chrome trace event format
+    (the JSON-object form Perfetto loads). Returns a list of problems
+    (empty = valid) — the bench-smoke gate and the merge tests assert
+    it empty, so a malformed merge fails in CI instead of inside
+    Perfetto's error console."""
+    errs: list[str] = []
+    if not isinstance(obj, dict):
+        return ["top level is not a JSON object"]
+    evs = obj.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["traceEvents is missing or not a list"]
+    for i, ev in enumerate(evs):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errs.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _PH_ALLOWED:
+            errs.append(f"{where}: ph {ph!r} not in {sorted(_PH_ALLOWED)}")
+            continue
+        if not isinstance(ev.get("name"), str):
+            errs.append(f"{where}: name missing or not a string")
+        for key in ("pid", "tid"):
+            if not isinstance(ev.get(key), int):
+                errs.append(f"{where}: {key} missing or not an int")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            errs.append(f"{where}: args is not an object")
+        if ph == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errs.append(f"{where}: ts missing/negative")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errs.append(f"{where}: X event dur missing/negative")
+        if ph == "i" and ev.get("s") not in _INSTANT_SCOPES:
+            errs.append(f"{where}: instant scope s must be one of "
+                        f"{sorted(_INSTANT_SCOPES)}")
+    return errs

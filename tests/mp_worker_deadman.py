@@ -71,6 +71,10 @@ def main() -> int:
                  seed=0, save_model=True, keep_last_k=1, backend="cpu",
                  eval_every=2, watchdog_secs=60.0,
                  peer_deadline_secs=2.0, heartbeat_secs=0.25,
+                 # Pod tracer armed: the survivor's 87 ramp must flush
+                 # its span rings (the fatal-exit flush contract) so
+                 # the trace shows the seconds before the degradation.
+                 trace="phases",
                  resume=(phase == "resume"),
                  log_dir=os.path.join(scratch, "tb"),
                  ckpt_dir=os.path.join(scratch, "ck"))

@@ -48,11 +48,16 @@ def main() -> int:
     # so no cross-thread collective interleave either.)
     # log_every=2: the live status surface gets mid-epoch writes too
     # (the parent renders `python -m imagent_tpu.status` on the run).
+    # trace="phases": the pod tracer rides the same drill — every rank
+    # flushes trace/trace.<rank>.jsonl at its epoch boundaries, and
+    # the parent merges them into one skew-corrected Perfetto trace
+    # spanning both ranks and >= 3 subsystems (engine phases, the
+    # checkpoint committer thread, data staging).
     cfg = Config(arch="resnet18", image_size=16, num_classes=4,
                  batch_size=4, epochs=2, lr=0.05, dataset="synthetic",
                  synthetic_size=64, workers=0, bf16=False, log_every=2,
                  seed=0, save_model=True, keep_last_k=1, backend="cpu",
-                 eval_every=2,
+                 eval_every=2, trace="phases",
                  log_dir=os.path.join(scratch, "tb"),
                  ckpt_dir=os.path.join(scratch, "ck"))
     result = run(cfg)

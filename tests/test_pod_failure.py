@@ -612,6 +612,23 @@ def test_deadman_pod_drill_kill_and_requeue(tmp_path):
     assert fr is not None and fr["reason"] == "peer-dead", fr
     assert fr["exit_code"] == exitcodes.PEER_DEAD
     assert fr["records"], fr
+    # ...and its span rings on the same ramp (run with --trace phases):
+    # the trace of the death ends AT the death — deadman verdict
+    # instant, emergency-snapshot span, and the dispatch windows that
+    # preceded them — not at the last epoch boundary (there was none:
+    # the pod died mid-epoch 0).
+    from imagent_tpu.telemetry import trace as trace_lib
+    hdr, spans = trace_lib.read_trace(os.path.join(
+        scratch, "tb", "trace", "trace.0.jsonl"))
+    assert hdr is not None and hdr["rank"] == 0, hdr
+    names = {sp["n"] for sp in spans}
+    assert "pod/degraded" in names, names
+    assert "ckpt/emergency" in names, names
+    assert "dispatch" in names or "compile" in names, names
+    # Rank 1 died abruptly (host.die, no flush) — no trace file, by
+    # design: an un-flushable death loses its ring, never the run.
+    assert not os.path.exists(os.path.join(
+        scratch, "tb", "trace", "trace.1.jsonl"))
 
     # Requeue: a fresh pod resumes from the emergency snapshot.
     outs2, rcs2 = _launch_deadman("resume", scratch)

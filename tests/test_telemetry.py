@@ -224,7 +224,7 @@ def test_engine_rejects_bad_profile_flags(tmp_path):
 
 EPOCH_RECORD_KEYS = {"epoch", "wall_s", "goodput", "phases", "overlap",
                      "step_ms", "hosts", "stragglers", "counters",
-                     "hbm", "interrupted"}
+                     "hbm", "clock", "interrupted"}
 
 
 def _driven_session(tmp_path):
@@ -266,6 +266,42 @@ def test_jsonl_schema_golden(tmp_path):
     assert set(ep) == EPOCH_RECORD_KEYS | {"event", "schema", "t"}
     # Everything survived JSON: plain types only.
     json.dumps(events)
+
+
+def test_clock_record_single_host_and_skew_warn(tmp_path, monkeypatch,
+                                                capsys):
+    """The epoch record carries the per-rank (wall, mono) clock pairs
+    from the allgather; a single host measures zero skew, and a
+    synthetic 2-row matrix whose wall clocks disagree past
+    CLOCK_SKEW_WARN_S trips the master WARN."""
+    import imagent_tpu.telemetry as telemetry_pkg
+    from imagent_tpu.telemetry import CLOCK_SKEW_WARN_S
+
+    record = _driven_session(tmp_path)
+    clock = record["clock"]
+    assert len(clock["wall"]) == 1 and len(clock["mono"]) == 1
+    assert clock["max_skew_s"] == 0.0
+    # The pair is captured at pack time: wall ~ now, mono ~ the
+    # process perf_counter — both plain floats in the record.
+    assert abs(clock["wall"][0] - time.time()) < 60.0
+
+    skew = CLOCK_SKEW_WARN_S + 2.5
+
+    def fake_allgather(local):
+        row0 = aggregate.pack_host_vector(local)
+        row1 = row0.copy()
+        row1[HOST_FIELDS.index("clock_wall_s")] += skew
+        return np.stack([row0, row1])
+
+    monkeypatch.setattr(telemetry_pkg, "allgather_host_stats",
+                        fake_allgather)
+    cfg = Config(log_dir=str(tmp_path))
+    telem = TelemetrySession(cfg, is_master=True)
+    telem.epoch_begin()
+    rec = telem.epoch_end(0)
+    assert rec["clock"]["max_skew_s"] == pytest.approx(skew, abs=0.05)
+    out = capsys.readouterr().out
+    assert "pod wall-clock skew" in out and "fix NTP" in out
 
 
 def test_jsonl_reader_skips_torn_and_future_lines(tmp_path):
@@ -375,6 +411,70 @@ def test_pod_telemetry_two_process_engine_run(tmp_path):
     assert "frontier: epoch 2/2" in proc.stdout, proc.stdout
     assert "health: grad_norm ewma" in proc.stdout, proc.stdout
     assert "goodput" in proc.stdout, proc.stdout
+    # Clock-skew surfacing: the epoch record carries the per-rank
+    # (wall, mono) pairs from the allgather plus the measured pod max
+    # skew; status.json and the status CLI render it (same-box ranks:
+    # skew is bounded by the boundary arrival spread).
+    for rec in epochs:
+        clock = rec.get("clock")
+        assert clock and len(clock["wall"]) == 2 \
+            and len(clock["mono"]) == 2, rec
+        assert clock["max_skew_s"] >= 0.0
+    assert st.get("clock_skew_s") is not None, st
+    assert "clock skew: max" in proc.stdout, proc.stdout
+
+    # ---- pod tracer acceptance (ISSUE 12): both ranks produced span
+    # files that merge into ONE skew-corrected Chrome-format trace
+    # with spans from >= 2 ranks and >= 3 subsystems, and the traced
+    # phase spans agree with the goodput accountant within 5% of
+    # epoch wall.
+    from imagent_tpu.telemetry import trace as trace_lib
+    traces = trace_lib.load_run_traces(str(tmp_path / "tb"))
+    assert [r for r, _h, _s in traces] == [0, 1], traces
+    for _rank, hdr, spans in traces:
+        assert hdr is not None and spans, (hdr, len(spans))
+    # Per-epoch trace summaries rode the epoch records (rank 0's).
+    assert all((rec.get("trace") or {}).get("spans", 0) > 0
+               for rec in epochs), epochs
+    assert sum((rec.get("trace") or {}).get("dropped", 0)
+               for rec in epochs) == 0
+    # Consistency: rank 0's phase spans vs rank 0's accountant phases.
+    spans0 = traces[0][2]
+    traced = sum(trace_lib.phase_span_seconds(spans0).values())
+    acct = sum(v for rec in epochs
+               for k, v in rec["phases"].items() if k != "host_other")
+    wall = sum(rec["wall_s"] for rec in epochs)
+    assert abs(traced - acct) <= 0.05 * wall, (traced, acct, wall)
+    # >= 3 subsystems, across the pod: engine phase spans on BOTH
+    # ranks, the committer thread's commit span (process 0 writes),
+    # and data staging spans.
+    all_spans = [sp for _r, _h, sps in traces for sp in sps]
+    assert any(sp.get("c") == trace_lib.PHASE_CAT
+               for sp in traces[1][2]), "rank 1 has no phase spans"
+    names = {sp["n"] for sp in all_spans}
+    assert "ckpt/commit" in names and "ckpt/snapshot" in names, names
+    assert "data/stage" in names, names
+    commit = next(sp for sp in all_spans if sp["n"] == "ckpt/commit")
+    assert commit["tn"].startswith("ckpt-commit"), commit
+    assert commit["a"]["verdict"] == "ok", commit
+    # The merge: valid Chrome trace, pids 0 and 1, skew corrected for
+    # both ranks via the epoch-boundary clock record.
+    merged = trace_lib.merge(str(tmp_path / "tb"))
+    assert trace_lib.validate_chrome_trace(merged) == []
+    pids = {ev["pid"] for ev in merged["traceEvents"]
+            if ev["ph"] != "M"}
+    assert pids == {0, 1}, pids
+    other = merged["otherData"]
+    assert other["skew_corrected"] == {"0": True, "1": True}, other
+    assert other["ref_rank"] == 0
+    # The CLI writes trace.json and reports the skew line.
+    proc = subprocess.run(
+        [_sys.executable, "-m", "imagent_tpu.telemetry", "trace",
+         str(tmp_path / "tb"), "--top", "5"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "clock skew: max" in proc.stdout, proc.stdout
+    assert (tmp_path / "tb" / "trace" / "trace.json").is_file()
 
 
 def test_input_wait_alert_fraction_and_streak(tmp_path):
