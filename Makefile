@@ -5,7 +5,7 @@ PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
 
 .PHONY: smoke test lint bench-smoke bench-anatomy bench-input \
-	drill-pod drill-divergence trace-smoke
+	drill-pod drill-divergence drill-elastic trace-smoke
 
 # Static-analysis gate (docs/STATIC_ANALYSIS.md): jaxlint — the
 # JAX/TPU-aware rules in imagent_tpu/analysis — over the package, the
@@ -56,6 +56,18 @@ drill-pod:
 drill-divergence:
 	$(PYTEST) -m "not slow" tests/test_health.py
 	$(PYTEST) -m "not slow" tests/test_fault_drills.py -k divergence
+
+# Elastic-pod suite (docs/OPERATIONS.md "Elastic pod: shrink, grow,
+# and the batch contract"): the tier-1 acceptance drill — a REAL
+# 4-process CPU pod loses a rank mid-epoch (host.die), the survivors
+# re-form a 3-host mesh and keep training (pod_resized event, no
+# sample replayed or skipped), a fresh 4-process --resume re-expands,
+# and the final loss matches the uninterrupted run within tolerance —
+# plus the hb.flap no-split-brain drill, the rendezvous/roster unit
+# tests, the stream re-sharding invariance matrix, and the
+# elastic-flag validation. All tier-1.
+drill-elastic:
+	$(PYTEST) -m "not slow" tests/test_elastic.py
 
 # Pod tracer suite (docs/OPERATIONS.md "Reading a pod trace"): the
 # span recorder / torn-tail reader / skew-corrected merge unit tests,

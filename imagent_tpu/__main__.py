@@ -14,6 +14,45 @@ import sys
 
 from imagent_tpu.config import parse_args
 
+# Bound on in-place elastic exec-restarts (each resize re-execs the
+# process so jax.distributed re-initializes cleanly); past it the
+# process exits with the retryable POD_RESIZE code and the requeue
+# wrapper's budget takes over.
+_ELASTIC_EXEC_CAP_ENV = "IMAGENT_ELASTIC_EXEC_CAP"
+_ELASTIC_EXECS_ENV = "IMAGENT_ELASTIC_EXECS"
+
+
+def _elastic_reexec(argv) -> None:
+    """Exec-restart this process into the elastic rendezvous: same
+    argv + ``--resume``, fresh interpreter image — the only reliable
+    way to re-run ``jax.distributed.initialize`` over the survivor
+    set (the old client's shutdown barrier can never complete against
+    a dead peer; exec replaces the image without running it, exactly
+    like the ``os._exit`` the peer-death ramp already uses). Returns
+    only on failure/cap — the caller then exits POD_RESIZE and the
+    requeue wrapper restarts us instead."""
+    execs = int(os.environ.get(_ELASTIC_EXECS_ENV, "0") or 0)
+    cap = int(os.environ.get(_ELASTIC_EXEC_CAP_ENV, "8") or 8)
+    if execs >= cap:
+        print(f"elastic: in-place restart budget ({cap}) exhausted; "
+              "exiting for the requeue wrapper", flush=True)
+        return
+    os.environ[_ELASTIC_EXECS_ENV] = str(execs + 1)
+    args = [a for a in (argv if argv is not None else sys.argv[1:])]
+    if "--resume" not in args:
+        args.append("--resume")
+    print(f"elastic: exec-restarting into the rendezvous "
+          f"(restart {execs + 1}/{cap}): python -m imagent_tpu "
+          + " ".join(args), flush=True)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    try:
+        os.execv(sys.executable,
+                 [sys.executable, "-m", "imagent_tpu", *args])
+    except OSError as e:
+        print(f"elastic: exec-restart failed ({e}); exiting for the "
+              "requeue wrapper", flush=True)
+
 
 def main(argv=None) -> int:
     cfg = parse_args(argv)
@@ -36,6 +75,13 @@ def main(argv=None) -> int:
     except exitcodes.FatalRunError as e:
         print(f"FATAL ({e.reason}): {e}", flush=True)
         code = _announce(e.exit_code)
+        if isinstance(e, exitcodes.PodResizeError):
+            # Elastic continue: the salvage snapshot is durable and the
+            # dead session is departed (done-beat) — re-exec straight
+            # into the survivor rendezvous. Falls through to a
+            # hard-exit 89 (requeue wrapper path) if exec is
+            # unavailable or the in-place budget ran out.
+            _elastic_reexec(argv)
         if isinstance(e, exitcodes.PeerDeathError):
             # A normal interpreter exit runs the JAX distributed
             # client's shutdown barrier — with a DEAD peer it can never
@@ -57,6 +103,14 @@ def main(argv=None) -> int:
 
         traceback.print_exc()
         return _announce(exitcodes.FATAL_EXCEPTION)
+    if summary.get("resize_grow"):
+        # Pod-agreed GROW stop: a waiting host filed a join request and
+        # every member checkpointed at the same step. Re-form the
+        # larger pod in place; exit POD_RESIZE for the wrapper if exec
+        # is unavailable.
+        code = _announce(exitcodes.POD_RESIZE)
+        _elastic_reexec(argv)
+        return code
     if summary.get("preempted"):
         # Clean checkpoint-and-exit (SIGTERM notice or the watchdog's
         # clean path): the mid-epoch checkpoint is durable, --resume

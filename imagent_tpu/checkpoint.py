@@ -82,6 +82,14 @@ _META_FIELDS = (
     ("health_grad_ewma", np.float64, 0.0),
     ("health_ratio_ewma", np.float64, 0.0),
     ("health_ewma_n", np.int64, 0),
+    # Elastic-resume additions (appended; older checkpoints default):
+    # device_count pins the writing pod's data-parallel size so a
+    # resized resume can report the grad-accum adjustment it implies,
+    # and emergency=1 marks a degraded-pod salvage snapshot — the
+    # status/summarize CLIs surface it, and a resume says what it is
+    # restoring instead of presenting a salvage as a clean LAST.
+    ("device_count", np.int64, 0),
+    ("emergency", np.int64, 0),
 )
 
 _ckptr: ocp.StandardCheckpointer | None = None
@@ -697,7 +705,8 @@ def wait_until_finished() -> dict | None:
 
 
 def save_emergency(ckpt_dir: str, name: str, state: TrainState,
-                   meta: dict, keep_last_k: int = 0) -> bool:
+                   meta: dict, keep_last_k: int = 0,
+                   any_rank: bool = False) -> bool:
     """Process 0's DEGRADED-POD save: commit ``state`` as ``name`` with
     **no collectives and no barriers** — the flat snapshot format was
     designed for exactly this moment (pure local file I/O, restorable
@@ -720,7 +729,11 @@ def save_emergency(ckpt_dir: str, name: str, state: TrainState,
         _async_outstanding
     import shutil
 
-    if jax.process_index() != 0:
+    if jax.process_index() != 0 and not any_rank:
+        # ``any_rank``: the elastic ramp picks the LOWEST SURVIVOR as
+        # the lander (process 0 itself may be the dead host) — the flat
+        # format is pure local file I/O, so any single host can commit
+        # it; the caller guarantees exactly one does.
         return False
     ckpt_dir = os.path.abspath(ckpt_dir)
     t = _commit_thread

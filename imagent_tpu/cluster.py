@@ -48,6 +48,17 @@ class SlurmEnv:
     global_rank: int
     world_size: int
     coordinator: str  # first hostname of SLURM_JOB_NODELIST
+    # Elastic pod (``--elastic``, imagent_tpu/elastic.py): after a
+    # rendezvous, ``global_rank``/``world_size``/``coordinator`` hold
+    # the ACTIVE session geometry (what jax.distributed was initialized
+    # with) and these carry the launched identity: the scheduler slot
+    # this process was started as (heartbeat/tombstone identity, stable
+    # across resizes), the committed roster's members (launched ranks),
+    # and the roster attempt. 0/-1/() on the non-elastic path.
+    launched_world: int = 0
+    launched_rank: int = -1
+    elastic_attempt: int = 0
+    members: tuple = ()
 
     @property
     def is_coordinator(self) -> bool:
@@ -150,7 +161,9 @@ def parse_slurm_env(env: Mapping[str, str]) -> SlurmEnv | None:
 
 def initialize(backend: str | None = None,
                env: Mapping[str, str] | None = None,
-               port: int | None = None) -> SlurmEnv | None:
+               port: int | None = None,
+               elastic_dir: str | None = None,
+               elastic_settle: float = 10.0) -> SlurmEnv | None:
     """Initialize the distributed runtime.
 
     Replaces ``imagenet.py:237-273``: under Slurm with >1 task, call
@@ -158,6 +171,18 @@ def initialize(backend: str | None = None,
     (PJRT coordination service); single-process runs skip it. ``backend``
     selects the PJRT platform (the reference's ``--backend nccl`` analogue,
     ``imagenet.py:440``).
+
+    ``elastic_dir`` (``--elastic``): before touching jax.distributed,
+    run the filesystem rendezvous (``imagent_tpu/elastic.py``) — the
+    processes that actually showed up commit a roster, and THAT decides
+    ``(num_processes, process_id, coordinator, port)``: a pod that lost
+    a host re-forms at world N-1 on a fresh coordinator port instead of
+    timing out against the scheduler's stale geometry; a full relaunch
+    with the replacement present re-expands to N the same way. The
+    returned ``SlurmEnv`` then carries both the active and the launched
+    geometry (see the dataclass). Raises
+    ``exitcodes.ElasticExcludedError`` when the roster committed
+    without this host.
     """
     # Operator-compat mapping for the reference's flag values
     # (``imagenet.py:440``, invoked as ``--backend=nccl`` at
@@ -200,6 +225,39 @@ def initialize(backend: str | None = None,
                 raise ValueError(
                     f"IMAGENT_COORDINATOR_PORT={raw!r} is not a port "
                     "number") from None
+        if elastic_dir is not None:
+            from imagent_tpu import elastic as elastic_lib
+            ros = elastic_lib.rendezvous(
+                elastic_dir, senv.global_rank, senv.world_size, port,
+                settle_secs=elastic_settle)
+            members = [int(r) for r in ros["members"]]
+            active_rank = members.index(senv.global_rank)
+            senv = dataclasses.replace(
+                senv,
+                launched_world=senv.world_size,
+                launched_rank=senv.global_rank,
+                world_size=len(members), global_rank=active_rank,
+                coordinator=str(ros["coordinator"]),
+                elastic_attempt=int(ros["attempt"]),
+                members=tuple(members))
+            if len(members) > 1:
+                jax.distributed.initialize(
+                    coordinator_address=(f"{ros['coordinator']}:"
+                                         f"{int(ros['port'])}"),
+                    num_processes=len(members),
+                    process_id=active_rank,
+                )
+            else:
+                # Shrunk all the way to one host: no distributed
+                # runtime — the gloo CPU collectives armed above would
+                # demand a distributed client at backend init, so
+                # un-arm them (single-process psums are local).
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", None)
+                except Exception:
+                    pass
+            return senv
         jax.distributed.initialize(
             coordinator_address=f"{senv.coordinator}:{port}",
             num_processes=senv.world_size,
@@ -214,11 +272,16 @@ def rank_banner(senv: SlurmEnv | None) -> str:
     if senv is None:
         return (f"[proc {jax.process_index()}/{jax.process_count()}] "
                 f"devices={jax.local_device_count()} (no Slurm env)")
+    elastic = ""
+    if senv.launched_world and senv.launched_world != senv.world_size:
+        elastic = (f" ELASTIC (launched slot {senv.launched_rank}/"
+                   f"{senv.launched_world}, roster attempt "
+                   f"{senv.elastic_attempt})")
     return (
         f"[rank {senv.global_rank}/{senv.world_size}] "
         f"node {senv.node_id}/{senv.n_nodes} local_rank {senv.local_rank} "
         f"coordinator {senv.coordinator} "
-        f"local_devices={jax.local_device_count()}"
+        f"local_devices={jax.local_device_count()}" + elastic
     )
 
 

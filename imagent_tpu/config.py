@@ -224,6 +224,30 @@ class Config:
     peer_deadline_secs: float = 0.0
     # Heartbeat write cadence for the mesh above.
     heartbeat_secs: float = 2.0
+    # Elastic pod (imagent_tpu/elastic.py): when a peer dies the
+    # deadman verdict becomes CONTINUE — survivors land the salvage
+    # snapshot and re-initialize as a SMALLER mesh over the pod-agreed
+    # roster (shrink-to-survive); a relaunch with the replacement host
+    # present re-expands (grow-on-requeue), and a waiting host's join
+    # request stops the running pod at a pod-agreed step to re-form.
+    # Requires --global-batch (the optimization trajectory must not
+    # follow the world size) and the plain data-parallel path. Implies
+    # resume-if-checkpoint-exists so every rendezvoused attempt agrees
+    # on the restore.
+    elastic: bool = False
+    # Fixed GLOBAL optimization batch, decoupled from world size:
+    # per-host batch x grad-accum is recomputed as
+    # global_batch / (batch_size x data_parallel_size) on every
+    # (re)start, so a resize changes gradient-accumulation depth, not
+    # the loss trajectory. 0 = legacy behavior (global batch =
+    # batch_size x dp x grad_accum). Must be divisible by
+    # batch_size x dp at every world size the pod may shrink/grow to.
+    global_batch: int = 0
+    # Elastic rendezvous settle window: the roster leader commits the
+    # partial join set after this long with no new joiner (a full
+    # world commits immediately). Bounds how long a resize waits for
+    # a slow host before excluding it (it becomes a grow request).
+    elastic_settle_secs: float = 10.0
 
     # ---- mesh geometry / parallelism strategies ----
     # Data-parallel size is inferred (devices / model_parallel). A model axis
@@ -504,6 +528,23 @@ def build_parser() -> argparse.ArgumentParser:
                    default=c.heartbeat_secs,
                    help="per-host heartbeat write cadence for the "
                         "peer deadman (default 2s)")
+    p.add_argument("--elastic", action="store_true", default=False,
+                   help="elastic pod: survivors of a peer death "
+                        "re-form a smaller mesh and keep training "
+                        "(shrink-to-survive); relaunches re-expand "
+                        "(grow-on-requeue). Requires --global-batch; "
+                        "DP path only; implies resume-if-checkpoint")
+    p.add_argument("--global-batch", type=int, default=c.global_batch,
+                   help="fixed global optimization batch, decoupled "
+                        "from world size: grad-accum is derived as "
+                        "global_batch/(batch_size x dp) so a resize "
+                        "keeps the loss trajectory (0 = legacy "
+                        "batch_size x dp x grad_accum)")
+    p.add_argument("--elastic-settle-secs", type=float,
+                   default=c.elastic_settle_secs,
+                   help="elastic rendezvous settle window: commit the "
+                        "partial roster after this long with no new "
+                        "joiner (full world commits immediately)")
     p.add_argument("--model-parallel", type=int, default=c.model_parallel)
     p.add_argument("--seq-parallel", type=str, default=c.seq_parallel,
                    choices=["none", "ring", "ulysses"])

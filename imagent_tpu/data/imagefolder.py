@@ -396,7 +396,7 @@ class ImageFolderLoader:
                       step: int = 0) -> Batch:
         valid = rows[rows != PAD_ROW]
         stream.trace_rows(self.process_index, self.split, epoch, step,
-                          valid)
+                          valid, world=self.process_count)
         images = None
         client = self._ensure_offload()
         if client is not None:

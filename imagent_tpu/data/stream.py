@@ -150,18 +150,24 @@ def iter_batch_rows(idx: np.ndarray, local_rows: int):
 
 
 def trace_rows(process_index: int, split: str, epoch: int, step: int,
-               rows: np.ndarray) -> None:
+               rows: np.ndarray, world: int | None = None) -> None:
     """Append one produced batch's dataset rows to the armed trace
     file (no-op unless :data:`TRACE_ENV` is set — a falsy env check,
     safe at per-batch cadence). The trace records PRODUCED batches;
     a consumer killed mid-epoch may have decoded a few beyond its last
     applied step, so drill readers truncate to the checkpoint's
-    ``resume_step`` before concatenating (tests/mp_worker_resume.py)."""
+    ``resume_step`` before concatenating (tests/mp_worker_resume.py).
+    ``world`` (the stream's process_count) disambiguates records
+    across elastic resizes: an exec-restarted attempt appends to the
+    same per-index file at a different world size, and the
+    re-sharding drills filter on it."""
     prefix = os.environ.get(TRACE_ENV)
     if not prefix:
         return
     rec = {"split": split, "epoch": int(epoch), "step": int(step),
            "rows": [int(r) for r in rows]}
+    if world is not None:
+        rec["world"] = int(world)
     with open(f"{prefix}.{process_index}.jsonl", "a") as f:
         f.write(json.dumps(rec) + "\n")
 

@@ -109,7 +109,7 @@ class SyntheticLoader:
                                              start_step):
             valid = rows[rows != PAD_ROW]
             stream.trace_rows(self.process_index, self.split, epoch,
-                              step, valid)
+                              step, valid, world=self.process_count)
             labels = labels_all[valid].astype(np.int32)
             # Distinct noise draws for train vs val rows (same class
             # patterns, different samples → a real generalization split).

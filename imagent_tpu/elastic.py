@@ -1,0 +1,322 @@
+"""Elastic pod membership: filesystem rendezvous + roster protocol.
+
+``jax.distributed.initialize`` needs ``(num_processes, process_id,
+coordinator)`` *before* any collective can run — which is exactly what
+a pod that just lost a host no longer knows. This module answers it
+out-of-band, the same way the heartbeat mesh answers liveness: small
+atomic JSON files in ``<log_dir>/elastic/`` on the storage every host
+already shares.
+
+Protocol (one *attempt* = one rendezvous round; attempts strictly
+increase across resizes and requeues):
+
+* every participant writes ``join.<attempt>.<rank>.json``
+  (``{rank, host, pid, t}``) and polls for a roster;
+* the LEADER — the lowest launched rank among the round's joiners —
+  publishes ``roster.<attempt>.json`` the moment all launched ranks
+  have joined (the fast full-world path), or after ``settle_secs``
+  with no new joiner (the shrink path: the dead host never joins).
+  Publication uses an exclusive create, so exactly one roster exists
+  per attempt — the ATOMIC COMMIT POINT of the resize: a host is a
+  member or it is not, and there is no state in between (the
+  no-split-brain property the ``hb.flap`` drill pins);
+* ``roster.json`` (atomic copy of the newest roster) is the CURRENT
+  membership every other subsystem consults: the deadman scan reads it
+  to detect "the pod re-formed without me" (a flapping host that beat
+  past the deadline and returned), and the engine's master polls for
+  join files NEWER than it — a standing **grow request** from an
+  excluded/relaunched host that the running pod admits at its next
+  pod-agreed stop.
+
+Mapping onto ``jax.distributed``: members are LAUNCHED ranks (the
+stable host slots from the scheduler); the active process id is the
+member's index in the sorted roster, the coordinator is member 0's
+host, and the port walks ``base_port + attempt`` so a re-formed
+session never collides with the dead session's half-closed coordinator
+socket. Heartbeats/tombstones stay keyed by launched rank across
+resizes, so liveness identity survives the re-numbering.
+
+This module is **jax-free** (asserted by tests/test_elastic.py): the
+rendezvous runs precisely when the JAX runtime is not (yet) usable.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+import time
+
+from imagent_tpu.resilience import exitcodes
+from imagent_tpu.telemetry.events import read_json, write_json_atomic
+
+ELASTIC_DIRNAME = "elastic"
+ROSTER_FILENAME = "roster.json"  # atomic copy of the newest roster
+HOST_ENV = "IMAGENT_HOST_ADDR"   # override for this host's address
+PATIENCE_ENV = "IMAGENT_ELASTIC_PATIENCE_SECS"
+_PORT_SPAN = 512  # coordinator port walks base + (attempt % span)
+
+_JOIN_RE = re.compile(r"^join\.(\d+)\.(\d+)\.json$")
+
+
+def elastic_dir(log_dir: str) -> str:
+    return os.path.join(log_dir, ELASTIC_DIRNAME)
+
+
+def this_host() -> str:
+    """The address peers should dial for a coordinator on this host:
+    ``IMAGENT_HOST_ADDR`` when set (drills pin 127.0.0.1), else the
+    hostname — resolvable across a Slurm/TPU pod by construction."""
+    return os.environ.get(HOST_ENV) or socket.gethostname()
+
+
+def _join_path(edir: str, attempt: int, rank: int) -> str:
+    return os.path.join(edir, f"join.{int(attempt)}.{int(rank)}.json")
+
+
+def _roster_path(edir: str, attempt: int) -> str:
+    return os.path.join(edir, f"roster.{int(attempt)}.json")
+
+
+def read_roster(edir: str) -> dict | None:
+    """The CURRENT roster (newest published attempt), or None."""
+    ros = read_json(os.path.join(edir, ROSTER_FILENAME))
+    if ros is None or "attempt" not in ros or "members" not in ros:
+        return None
+    return ros
+
+
+def next_attempt(edir: str) -> int:
+    """The attempt number a fresh rendezvous round must use: one past
+    the current roster (every participant computes the same value from
+    the same shared file, which is what makes them meet)."""
+    ros = read_roster(edir)
+    return int(ros["attempt"]) + 1 if ros is not None else 1
+
+
+def write_join(edir: str, attempt: int, rank: int,
+               host: str | None = None) -> None:
+    write_json_atomic(_join_path(edir, attempt, rank), {
+        "rank": int(rank), "attempt": int(attempt),
+        "host": host or this_host(), "pid": os.getpid(),
+        "t": round(time.time(), 3)})
+
+
+def read_joiners(edir: str, attempt: int) -> dict[int, dict]:
+    """``{launched_rank: join record}`` for one attempt (torn/foreign
+    files skipped)."""
+    out: dict[int, dict] = {}
+    try:
+        entries = os.listdir(edir)
+    except OSError:
+        return out
+    for entry in entries:
+        m = _JOIN_RE.match(entry)
+        if m is None or int(m.group(1)) != int(attempt):
+            continue
+        rec = read_json(os.path.join(edir, entry))
+        if rec is not None:
+            out[int(m.group(2))] = rec
+    return out
+
+
+def pending_joiners(edir: str, roster: dict) -> list[int]:
+    """Launched ranks with join files NEWER than the current roster —
+    standing grow requests from hosts waiting to be admitted. Cheap
+    (one listdir); the engine's master polls it throttled and any-
+    reduces the verdict so the stop is pod-agreed."""
+    pend: set[int] = set()
+    cur = int(roster.get("attempt", 0))
+    members = set(int(r) for r in roster.get("members", ()))
+    try:
+        entries = os.listdir(edir)
+    except OSError:
+        return []
+    for entry in entries:
+        m = _JOIN_RE.match(entry)
+        if m is not None and int(m.group(1)) > cur \
+                and int(m.group(2)) not in members:
+            pend.add(int(m.group(2)))
+    return sorted(pend)
+
+
+def _clean_joins(edir: str, before_attempt: int) -> None:
+    """Drop join files of attempts older than ``before_attempt`` (the
+    leader's housekeeping at publication — stale joins must not read
+    as grow requests forever)."""
+    try:
+        entries = os.listdir(edir)
+    except OSError:
+        return
+    for entry in entries:
+        m = _JOIN_RE.match(entry)
+        if m is not None and int(m.group(1)) < int(before_attempt):
+            try:
+                os.remove(os.path.join(edir, entry))
+            except OSError:
+                pass
+
+
+def roster_port(base_port: int, attempt: int) -> int:
+    """Coordinator port for one attempt: walks forward so a re-formed
+    session never dials the dead session's half-closed socket."""
+    return 1024 + (int(base_port) - 1024 + int(attempt) % _PORT_SPAN) \
+        % (65536 - 1024)
+
+
+def _publish(edir: str, attempt: int, joiners: dict[int, dict],
+             base_port: int, launched_world: int) -> dict:
+    """Atomically commit the roster for ``attempt`` (exclusive create:
+    first publisher wins; a loser adopts the winner's roster)."""
+    members = sorted(int(r) for r in joiners)
+    roster = {
+        "attempt": int(attempt),
+        "members": members,
+        "world": len(members),
+        "launched_world": int(launched_world),
+        "coordinator": joiners[members[0]].get("host") or this_host(),
+        "port": roster_port(base_port, attempt),
+        "t": round(time.time(), 3),
+    }
+    path = _roster_path(edir, attempt)
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        won = read_json(path)
+        return won if won is not None else roster
+    try:
+        import json
+        os.write(fd, json.dumps(roster).encode())
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    write_json_atomic(os.path.join(edir, ROSTER_FILENAME), roster)
+    _clean_joins(edir, attempt)
+    return roster
+
+
+def rendezvous(edir: str, rank: int, launched_world: int,
+               base_port: int, settle_secs: float = 10.0,
+               patience_secs: float | None = None,
+               host: str | None = None, out=None) -> dict:
+    """Join the next rendezvous round and return the committed roster
+    this host is a member of.
+
+    * Full world joined → the leader publishes immediately (a healthy
+      launch pays one file round-trip, not the settle window).
+    * ``settle_secs`` with no new joiner → the leader commits the
+      partial set (the shrink path; a host merely SLOW to start is
+      excluded and becomes a grow request — safe, never split).
+    * Excluded from the round it joined → this host re-joins the NEXT
+      attempt (its join file is the standing grow request) and keeps
+      waiting; after ``patience_secs`` (env
+      ``IMAGENT_ELASTIC_PATIENCE_SECS``, default
+      ``max(300, 10 x settle)``) it raises
+      ``exitcodes.ElasticExcludedError`` for the requeue wrapper.
+    """
+    os.makedirs(edir, exist_ok=True)
+    host = host or this_host()
+    if patience_secs is None:
+        raw = os.environ.get(PATIENCE_ENV, "")
+        patience_secs = (float(raw) if raw
+                         else max(300.0, 10.0 * settle_secs))
+    say = out if out is not None else (lambda m: print(m, flush=True))
+    attempt = next_attempt(edir)
+    write_join(edir, attempt, rank, host)
+    say(f"elastic: rank {rank} joined rendezvous attempt {attempt} "
+        f"(launched world {launched_world}, settle {settle_secs:g}s)")
+    t_deadline = time.monotonic() + max(patience_secs, 1.0)
+    poll = min(max(settle_secs / 8.0, 0.05), 0.5)
+    # Joiners are counted only while FRESH (refreshed below at
+    # settle/2): a waiter that crashed or gave up must not be admitted
+    # into a roster it can never rendezvous with — jax.distributed
+    # would hang on the phantom member. The floor tolerates minute-
+    # class cross-host wall-clock skew.
+    fresh_within = max(4.0 * settle_secs, 60.0)
+    last_refresh = time.monotonic()
+    seen: set[int] = set()
+    last_change = time.monotonic()
+    committed = False
+    try:
+        while True:
+            ros = read_roster(edir)
+            if ros is None or int(ros["attempt"]) < attempt:
+                # Crash window: a publisher that died between the
+                # exclusive attempt-file commit and the roster.json
+                # copy must not strand its waiters — the attempt file
+                # is authoritative.
+                direct = read_json(_roster_path(edir, attempt))
+                if direct is not None and "members" in direct:
+                    ros = direct
+            if ros is not None and int(ros["attempt"]) >= attempt:
+                members = [int(r) for r in ros.get("members", ())]
+                cur = read_json(os.path.join(edir, ROSTER_FILENAME))
+                if cur is None or int(cur.get("attempt", 0)) \
+                        < int(ros["attempt"]):
+                    # Repair the current-roster copy the publisher's
+                    # crash window may have skipped (consumers poll
+                    # roster.json).
+                    write_json_atomic(
+                        os.path.join(edir, ROSTER_FILENAME), ros)
+                if int(rank) in members:
+                    committed = True
+                    say(f"elastic: roster attempt {ros['attempt']} "
+                        f"committed — members {members} (world "
+                        f"{len(members)}/{launched_world}), coordinator "
+                        f"{ros.get('coordinator')}:{ros.get('port')}")
+                    return ros
+                # Committed without us: stand as a grow request on the
+                # next attempt and keep waiting for admission.
+                attempt = int(ros["attempt"]) + 1
+                write_join(edir, attempt, rank, host)
+                seen, last_change = set(), time.monotonic()
+                say(f"elastic: rank {rank} excluded from roster "
+                    f"attempt {ros['attempt']}; standing as a grow "
+                    f"request on attempt {attempt}")
+            if time.monotonic() > t_deadline:
+                raise exitcodes.ElasticExcludedError(
+                    f"rank {rank} was not admitted to any elastic "
+                    f"roster within {patience_secs:g}s (last attempt "
+                    f"{attempt}) — exiting for the requeue wrapper; a "
+                    "relaunch files a fresh grow request")
+            now = time.monotonic()
+            if now - last_refresh > max(settle_secs / 2.0, 0.5):
+                # Liveness refresh: our join record stays fresh while
+                # we wait (leaders ignore stale joiners below).
+                write_join(edir, attempt, rank, host)
+                last_refresh = now
+            recs = read_joiners(edir, attempt)
+            wall = time.time()
+            joiners = {r: rec for r, rec in recs.items()
+                       if wall - float(rec.get("t", 0.0)) < fresh_within}
+            if set(joiners) != seen:
+                seen = set(joiners)
+                last_change = now
+            # Leadership is MEMBER-GATED: only a member of the current
+            # roster may publish the next one (anyone may when no
+            # roster exists yet — the first launch). A relaunched
+            # EXCLUDED host must never commit a solo roster that
+            # dethrones the live pod (the other half of the
+            # no-split-brain property): it waits here as a standing
+            # grow request until a member-led round admits it.
+            gate = ([int(g) for g in ros["members"]]
+                    if ros is not None else None)
+            eligible = [r for r in joiners
+                        if gate is None or int(r) in gate]
+            if eligible and min(eligible) == int(rank):
+                if len(joiners) >= int(launched_world) \
+                        or now - last_change >= settle_secs:
+                    ros = _publish(edir, attempt, joiners, base_port,
+                                   launched_world)
+                    continue  # loop re-reads: winner or adopted roster
+            time.sleep(poll)
+    finally:
+        if not committed:
+            # Give-up hygiene: our join files must not stand as grow
+            # requests (or phantom members) once nobody is waiting
+            # behind them.
+            for a in range(max(attempt - 2, 1), attempt + 1):
+                try:
+                    os.remove(_join_path(edir, a, rank))
+                except OSError:
+                    pass

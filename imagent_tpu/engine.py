@@ -32,6 +32,7 @@ import numpy as np
 
 from imagent_tpu import checkpoint as ckpt_lib
 from imagent_tpu import cluster
+from imagent_tpu import elastic as elastic_lib
 from imagent_tpu.config import Config
 from imagent_tpu.data import make_loaders
 from imagent_tpu.data.pipeline import WIRE_DTYPES
@@ -690,10 +691,61 @@ def run(cfg: Config, stop_check=None) -> dict:
     collective-free emergency snapshot, and the run raises
     ``exitcodes.PeerDeathError`` (exit code 87, retryable) for the
     launcher's requeue wrapper. Every fatal exit path leaves a
-    tombstone record peers classify instantly."""
+    tombstone record peers classify instantly.
+
+    ``--elastic`` (with the fixed ``--global-batch`` contract) turns
+    the death verdict into CONTINUE: the lowest survivor lands the
+    salvage, every survivor departs on a done-beat and exec-restarts
+    into the filesystem rendezvous (``imagent_tpu/elastic.py``), and
+    the re-formed smaller pod restores the salvage at the exact
+    (epoch, step) frontier with gradient accumulation absorbing the
+    lost rank — the loss trajectory follows the batch, not the world
+    size. Grow rides join requests + the pod-agreed stop
+    (docs/OPERATIONS.md "Elastic pod")."""
+    # Elastic-pod flag contract, validated BEFORE any distributed init
+    # (a bad combination must fail on the launch host, not at pod
+    # rendezvous time).
+    if cfg.global_batch < 0:
+        raise ValueError("--global-batch must be >= 0 (0 = legacy "
+                         "batch_size x dp x grad_accum)")
+    if cfg.global_batch and cfg.grad_accum > 1:
+        raise ValueError(
+            "--grad-accum is DERIVED under the --global-batch "
+            "contract (global_batch / (batch_size x dp)); drop "
+            "--grad-accum, or drop --global-batch to size the global "
+            "batch from it")
+    if cfg.elastic:
+        if cfg.global_batch <= 0:
+            raise ValueError(
+                "--elastic requires --global-batch: a resize with the "
+                "global batch tied to world size would silently "
+                "change the optimization trajectory (lr/batch "
+                "contract). Set --global-batch to the fixed "
+                "optimization batch; grad accumulation absorbs the "
+                "lost/regained hosts.")
+        if (cfg.fsdp or cfg.zero1 or cfg.tensor_parallel
+                or cfg.seq_parallel != "none"
+                or cfg.pipeline_parallel > 1 or cfg.expert_parallel
+                or cfg.model_parallel > 1):
+            raise ValueError(
+                "--elastic supports the plain data-parallel path: "
+                "sharded state (fsdp/tp/sp/pp/ep/zero1) cannot be "
+                "salvaged or re-sharded without the dead peer "
+                "(ROADMAP item 2 is the sharded-state e2e work)")
+        if cfg.elastic_settle_secs <= 0:
+            raise ValueError("--elastic-settle-secs must be > 0")
     # cfg.backend selects the PJRT platform: "tpu" = runtime auto-select;
     # "cpu"/"gpu" are forced, overriding any environment preset.
-    senv = cluster.initialize(cfg.backend or None)
+    # --elastic: membership comes from the filesystem rendezvous (the
+    # roster of processes that actually showed up), not the scheduler
+    # env — a requeued pod missing a host re-forms at N-1 instead of
+    # timing out, and the full relaunch re-expands.
+    elastic_kw = {}
+    if cfg.elastic:
+        elastic_kw = dict(
+            elastic_dir=elastic_lib.elastic_dir(cfg.log_dir),
+            elastic_settle=cfg.elastic_settle_secs)
+    senv = cluster.initialize(cfg.backend or None, **elastic_kw)
     faultinject.configure(cfg.faults or None)
     if faultinject.active() and jax.process_index() == 0:
         print(f"FAULT DRILL: fault points armed ({cfg.faults or 'env'})",
@@ -713,10 +765,28 @@ def run(cfg: Config, stop_check=None) -> dict:
                 f"must be >= 2x --heartbeat-secs "
                 f"({cfg.heartbeat_secs:g}): a single missed write "
                 "would read as a host death")
-        pod = PodHeartbeat(cfg.log_dir, jax.process_index(),
-                           jax.process_count(),
+        # Heartbeat/tombstone identity is the LAUNCHED rank (the stable
+        # scheduler slot): it survives elastic re-numbering, so a
+        # re-formed pod keeps reading the same per-host files. The
+        # monitor watches only the current roster's members — a slot
+        # the pod already resized away must not be judged again.
+        launched_rank = jax.process_index()
+        launched_world = jax.process_count()
+        members = None
+        if senv is not None and getattr(senv, "members", ()):
+            launched_rank = senv.launched_rank
+            launched_world = senv.launched_world
+            members = list(senv.members)
+        pod = PodHeartbeat(cfg.log_dir, launched_rank, launched_world,
                            deadline_secs=cfg.peer_deadline_secs,
-                           interval_secs=cfg.heartbeat_secs)
+                           interval_secs=cfg.heartbeat_secs,
+                           members=members,
+                           continue_on_death=cfg.elastic,
+                           elastic_dir=(elastic_lib.elastic_dir(
+                               cfg.log_dir) if cfg.elastic else None),
+                           elastic_attempt=(getattr(
+                               senv, "elastic_attempt", 0)
+                               if senv is not None else 0))
         pod.start()
         deadman_lib.activate(pod)
     if cfg.trace not in trace_lib.MODES:
@@ -799,11 +869,15 @@ def run(cfg: Config, stop_check=None) -> dict:
         # Classified fatal exits (peer death, storage outage, rollback
         # give-up): span rings and flight recorder first (write-once —
         # an exit ramp may have flushed already), then the tombstone;
-        # its writer's write-once guard keeps the first cause.
+        # its writer's write-once guard keeps the first cause. A
+        # RESIZE is not a death: the survivors depart on a done-beat
+        # and re-form — a tombstone here would read as a fresh fatal
+        # to the very peers about to rendezvous with us.
         trace_lib.flush_active(fsync=True)
         flightrec_lib.flush_active(e.reason, e.exit_code,
                                    detail=str(e))
-        if pod is not None:
+        if pod is not None and not isinstance(
+                e, exitcodes.PodResizeError):
             pod.tombstone(e.reason, e.exit_code, detail=str(e))
         raise
     except ValueError as e:
@@ -886,30 +960,48 @@ def _pod_death_exit(cfg: Config, err, pod, telem, epoch: int,
     O(deadline), not O(world x deadline))."""
     v = dict(err.verdict or {})
     v["epoch"] = int(epoch)
+    is_resize = isinstance(err, exitcodes.PodResizeError)
+    v["continue"] = bool(is_resize)
     print(f"DEADMAN: {err} — landing what can be landed without "
-          f"collectives and exiting retryable "
-          f"(code {err.exit_code})", flush=True)
+          "collectives and "
+          + ("re-forming the pod on the survivors (elastic continue, "
+             f"code {err.exit_code})" if is_resize else
+             f"exiting retryable (code {err.exit_code})"), flush=True)
     telem.pod_degraded(v)
     salvage = err.salvage
-    if salvage is not None and jax.process_index() == 0:
+    # The salvage lander is the LOWEST SURVIVING member, not process 0:
+    # the dead host may BE process 0, and losing the salvage with it
+    # would turn every rank-0 death into a lost mid-epoch frontier.
+    # The flat emergency format is pure local file I/O, so any single
+    # host can commit it (checkpoint.save_emergency(any_rank=True)).
+    members = (list(pod.members) if pod is not None
+               else list(range(jax.process_count())))
+    my_rank = pod.rank if pod is not None else jax.process_index()
+    dead = {int(v["peer"])} if v.get("peer") is not None else set()
+    survivors = [r for r in members if r not in dead]
+    i_land = bool(survivors) and my_rank == min(survivors)
+    if salvage is not None and i_land:
         health_meta = (telem.health.meta_snapshot()
                        if telem.health is not None else {})
         meta = {**best_meta, **topo_meta, **health_meta,
                 "epoch": int(salvage["epoch"]),
-                "resume_step": int(salvage["resume_step"])}
+                "resume_step": int(salvage["resume_step"]),
+                "emergency": 1}
         try:
             if ckpt_lib.save_emergency(cfg.ckpt_dir, ckpt_lib.LAST,
                                        salvage["state"], meta,
-                                       keep_last_k=cfg.keep_last_k):
+                                       keep_last_k=cfg.keep_last_k,
+                                       any_rank=True):
                 print("DEADMAN: emergency snapshot committed as LAST "
                       f"(epoch {meta['epoch'] + 1}, "
-                      f"resume_step {meta['resume_step']}); --resume "
-                      "restores it", flush=True)
+                      f"resume_step {meta['resume_step']}, landed by "
+                      f"host {my_rank}); --resume restores it",
+                      flush=True)
         except Exception as se:
             print(f"WARNING: emergency snapshot failed "
                   f"({type(se).__name__}: {se}); the last committed "
                   "generation stands", flush=True)
-    if pod is not None:
+    if pod is not None and not is_resize:
         pod.tombstone(err.reason, err.exit_code, detail=str(err))
 
 
@@ -927,11 +1019,30 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
     n_data = mesh.shape[cluster.DATA_AXIS]
     if cfg.grad_accum < 1:
         raise ValueError("--grad-accum must be >= 1")
-    global_batch = cfg.batch_size * n_data * cfg.grad_accum
+    if cfg.global_batch:
+        # The fixed-global-batch contract (--global-batch, required by
+        # --elastic): the optimization batch is pinned and gradient
+        # accumulation absorbs the world size — a resize recomputes
+        # accum here, holding lr/batch (and so the loss trajectory)
+        # fixed across shrink and grow.
+        denom = cfg.batch_size * n_data
+        if cfg.global_batch % denom:
+            raise ValueError(
+                f"--global-batch {cfg.global_batch} is not divisible "
+                f"by batch_size x data_parallel = {cfg.batch_size} x "
+                f"{n_data} = {denom} at this world size. Pick a "
+                "global batch divisible at every world size the pod "
+                "may resize to (or adjust --batch-size).")
+        accum = cfg.global_batch // denom
+        global_batch = cfg.global_batch
+    else:
+        accum = cfg.grad_accum
+        global_batch = cfg.batch_size * n_data * accum
     if is_master:
         print(f"mesh {dict(mesh.shape)} global_batch {global_batch}"
-              + (f" (grad_accum {cfg.grad_accum})"
-                 if cfg.grad_accum > 1 else ""),
+              + (f" (grad_accum {accum})" if accum > 1 else "")
+              + (" [fixed --global-batch contract]"
+                 if cfg.global_batch else ""),
               flush=True)
 
     if len(cfg.color_jitter) != 3 or min(cfg.color_jitter) < 0.0:
@@ -1264,7 +1375,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
             model, optimizer, mesh, state_specs,
             label_smoothing=cfg.label_smoothing,
             aux_loss_weight=cfg.moe_aux_weight,
-            grad_accum=cfg.grad_accum,
+            grad_accum=accum,
             mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay,
             jitter_fn=jitter_fn, mean=cfg.mean, std=cfg.std,
             health_stats=cfg.health_stats)
@@ -1274,7 +1385,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         train_step = make_train_step(
             model, optimizer, mesh, seq_parallel=use_sp,
             label_smoothing=cfg.label_smoothing,
-            state_specs=state_specs, grad_accum=cfg.grad_accum,
+            state_specs=state_specs, grad_accum=accum,
             pipe_axis=cluster.PIPE_AXIS if use_pp else None,
             expert_parallel=use_ep, aux_loss_weight=cfg.moe_aux_weight,
             zero1=cfg.zero1, momentum=cfg.momentum,
@@ -1289,16 +1400,24 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         """(start_epoch, resume_step, best_top1, best_top5, best_epoch)
         from checkpoint meta, validating a mid-epoch checkpoint's
         loader-order fingerprint. Shared by --resume and the bad-step
-        rollback path."""
+        rollback path.
+
+        Topology-change-proof under the --global-batch contract: the
+        sample order is a pure function of (seed, epoch) and the
+        trained prefix a pure function of (global_batch, step) — the
+        per-step global row set ``order[s*G:(s+1)*G]`` does not depend
+        on how many hosts partitioned it (data/stream.py; pinned by
+        the re-sharding invariance tests) — so a mid-epoch frontier
+        restores onto ANY world size as long as seed and global batch
+        match. Without --global-batch the legacy strict check stands:
+        the global batch follows the world size, so a different
+        process count means a different loader order."""
         start_epoch = int(meta.get("epoch", -1)) + 1
         # Preemption checkpoints record how many optimizer steps of
         # the interrupted epoch are already applied; resume skips
         # exactly those batches (deterministic loader order).
         resume_step = int(meta.get("resume_step", 0))
         if resume_step > 0:
-            # The skipped-batch bookkeeping is only valid on the
-            # loader order it was recorded under — a pure function
-            # of (seed, epoch, process_count, global_batch).
             recorded = {"global_batch": int(meta.get("global_batch", 0)),
                         "process_count": int(
                             meta.get("process_count", 0)),
@@ -1311,6 +1430,30 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                     print("WARNING: mid-epoch checkpoint predates "
                           "topology recording; cannot verify the "
                           "resumed loader order matches", flush=True)
+            elif cfg.global_batch:
+                # Fixed-G contract: the stream frontier is world-size
+                # independent; only (seed, global_batch) pin the order.
+                fixed = {k: recorded[k] for k in ("global_batch",
+                                                  "seed")}
+                want = {k: current[k] for k in ("global_batch", "seed")}
+                if fixed != want:
+                    raise ValueError(
+                        f"mid-epoch resume contract mismatch: "
+                        f"checkpoint was written under {fixed} but "
+                        f"this run is {want} — under --global-batch "
+                        "these must match exactly (the trained "
+                        "prefix is keyed on them); the process count "
+                        "alone may differ (elastic resize).")
+                if (is_master and recorded["process_count"]
+                        and recorded["process_count"]
+                        != current["process_count"]):
+                    print("ELASTIC: mid-epoch frontier written by a "
+                          f"{recorded['process_count']}-host pod "
+                          "resumes on "
+                          f"{current['process_count']} host(s) — "
+                          "sample streams re-open at the exact "
+                          "(epoch, step) with shards rebalanced; no "
+                          "sample replayed or skipped", flush=True)
             elif recorded != current:
                 raise ValueError(
                     f"mid-epoch resume topology mismatch: checkpoint "
@@ -1318,8 +1461,10 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                     f"{current} — resuming would skip the wrong "
                     f"batches (some gradients twice, others never). "
                     f"Restart the epoch (delete the 'last' "
-                    f"checkpoint's resume_step) or match the "
-                    f"original topology.")
+                    f"checkpoint's resume_step), match the original "
+                    "topology, or adopt the fixed --global-batch "
+                    "contract (and --elastic) to make resumes "
+                    "topology-change-proof.")
             if (train_loader is not None
                     and resume_step >= train_loader.steps_per_epoch):
                 raise ValueError(
@@ -1334,16 +1479,59 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
 
     start_epoch, best_top1, best_top5, best_epoch = 0, 0.0, 0.0, -1
     resume_step = 0
-    if cfg.resume:
+    resized_info: dict | None = None
+    if cfg.resume or cfg.elastic:
         # Fallback-chain restore: a torn/corrupt LAST (kill mid-commit,
         # bit-rot) falls back to the previous LAST, then BEST, instead
         # of stranding the requeued run (resilience/integrity.py).
+        # --elastic implies resume-if-checkpoint-exists: every
+        # rendezvoused attempt must reach the same restore verdict —
+        # a newly-admitted replacement host launched WITHOUT --resume
+        # training from scratch while the survivors restore would be
+        # a split brain (restore_resilient pod-agrees the rest).
         restored = ckpt_lib.restore_resilient(cfg.ckpt_dir, state)
         if restored is not None:
             state, meta, src = restored
             state = place_state(state, mesh, state_specs)
+            if (cfg.global_batch
+                    and int(meta.get("global_batch", 0))
+                    and int(meta.get("global_batch", 0))
+                    != global_batch):
+                raise ValueError(
+                    f"--global-batch {global_batch} does not match "
+                    f"the checkpoint's recorded global batch "
+                    f"{int(meta['global_batch'])} — the fixed-batch "
+                    "contract pins the optimization trajectory; "
+                    "resuming with a different value would silently "
+                    "change it")
             (start_epoch, resume_step, best_top1, best_top5,
              best_epoch) = _resume_point(meta)
+            old_p = int(meta.get("process_count", 0))
+            if old_p and old_p != jax.process_count():
+                # Topology changed across the restore: the pod resized
+                # (shrink-to-survive or grow-on-requeue). Record the
+                # lr/accum adjustment for the pod_resized telemetry
+                # event emitted once the session is up.
+                old_d = int(meta.get("device_count", 0))
+                accum_prev = (int(meta["global_batch"])
+                              // (cfg.batch_size * old_d)
+                              if old_d and cfg.global_batch
+                              and int(meta.get("global_batch", 0))
+                              and int(meta["global_batch"])
+                              % (cfg.batch_size * old_d) == 0
+                              else None)
+                resized_info = {
+                    "from_processes": old_p,
+                    "to_processes": jax.process_count(),
+                    "from_devices": old_d or None,
+                    "to_devices": jax.device_count(),
+                    "global_batch": global_batch,
+                    "grad_accum": accum,
+                    "grad_accum_prev": accum_prev,
+                    "lr": lr_for_epoch(cfg, start_epoch),
+                    "epoch": start_epoch, "resume_step": resume_step,
+                    "emergency": int(meta.get("emergency", 0)),
+                }
             if monitor is not None and monitor.seed(meta) and is_master:
                 # A resume directly into a spike must be judged against
                 # the pre-crash baseline, not an empty one.
@@ -1354,8 +1542,21 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                 print(f"resumed from epoch {start_epoch}"
                       + (f" step {resume_step}" if resume_step else "")
                       + (f" (fallback checkpoint {src})"
-                         if src != ckpt_lib.LAST else ""),
+                         if src != ckpt_lib.LAST else "")
+                      + (" [EMERGENCY salvage snapshot]"
+                         if int(meta.get("emergency", 0)) else ""),
                       flush=True)
+                if resized_info is not None:
+                    adj = (f"grad_accum {resized_info['grad_accum_prev']}"
+                           f" -> {resized_info['grad_accum']}"
+                           if resized_info["grad_accum_prev"]
+                           else f"grad_accum {resized_info['grad_accum']}")
+                    print(f"POD RESIZED: {resized_info['from_processes']}"
+                          f" -> {resized_info['to_processes']} host(s) "
+                          f"at fixed global_batch {global_batch} — "
+                          f"{adj}, lr {resized_info['lr']:g} "
+                          "(unchanged: the trajectory follows the "
+                          "batch, not the world size)", flush=True)
 
     logger = TrainLogger(cfg.log_dir, is_master)
     if cfg.check_nans:
@@ -1365,9 +1566,12 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
 
     run_t0 = time.time()
     # Written into every checkpoint meta: the loader-order fingerprint a
-    # mid-epoch resume must match (see the resume guard above).
+    # mid-epoch resume must match (see the resume guard above), plus
+    # the data-parallel size so a resized resume can report the
+    # grad-accum adjustment the fixed --global-batch contract implies.
     topo_meta = {"global_batch": global_batch,
-                 "process_count": jax.process_count(), "seed": cfg.seed}
+                 "process_count": jax.process_count(), "seed": cfg.seed,
+                 "device_count": jax.device_count()}
     train_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
     val_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
     preempted = False
@@ -1431,14 +1635,28 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
     # runs/<run>/status.json at every --log-every boundary and epoch
     # exit; `python -m imagent_tpu.status <log_dir>` renders it.
     status = StatusWriter(cfg.log_dir) if is_master else None
+    # Launched vs active world: the scheduler slots this pod was
+    # started with vs the roster that actually formed — the status
+    # surface renders the difference so a silently-shrunk pod is
+    # visible on one screen.
+    launched_world = (getattr(senv, "launched_world", 0)
+                      if senv is not None else 0) or jax.process_count()
     telem.run_start({
         "arch": cfg.arch, "global_batch": global_batch,
         "process_count": jax.process_count(),
+        "launched_process_count": launched_world,
+        "elastic_attempt": (getattr(senv, "elastic_attempt", 0)
+                            if senv is not None else 0),
         "device_count": jax.device_count(),
         "steps_per_epoch": train_loader.steps_per_epoch,
         "start_epoch": start_epoch, "resume_step": resume_step,
         "seed": cfg.seed,
     })
+    if resized_info is not None:
+        # The resize verdict of THIS attempt (restore found a
+        # different world size than the checkpoint's): the lr/accum
+        # adjustment is on the record before the first step runs.
+        telem.pod_resized(dict(resized_info, phase="resize"))
 
     anomaly_hwm = [0]  # monitor.anomalies already attributed to epochs
     last_input_alert = [None]  # newest epoch's input-wait alert (if any)
@@ -1471,6 +1689,9 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
             # dead (or a deadline tuned too tight for the fs).
             telem.gauge("hb_peer_staleness_s",
                         round(pod.max_peer_staleness(), 3))
+        # Continuous pod/world_size series (elastic visibility): one
+        # float per epoch, a step down marks a shrink-to-survive.
+        telem.gauge("world_size", float(jax.process_count()))
         record = telem.epoch_end(ep, tm, interrupted=interrupted)
         last_input_alert[0] = (record or {}).get("input_wait_alert")
         last_clock_skew[0] = ((record or {}).get("clock")
@@ -1499,6 +1720,10 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                 "clock_skew_s": last_clock_skew[0],
                 "degraded": bool(pod is not None and pod.degraded),
                 "interrupted": bool(interrupted),
+                # Elastic visibility: current vs launched world — a
+                # silently-shrunk pod must be one glance away.
+                "world_size": jax.process_count(),
+                "launched_world_size": launched_world,
                 "health": (monitor.snapshot()
                            if monitor is not None else None),
             })
@@ -1572,6 +1797,69 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         else:
             pod.raise_if_degraded(state=state, epoch=epoch,
                                   resume_step=0)
+
+    # Grow-on-requeue: the master polls the elastic dir (throttled —
+    # one listdir every few seconds, jax-free) for join files NEWER
+    # than the committed roster: a standing request from an excluded /
+    # replacement host waiting in its own rendezvous. The verdict
+    # rides the EXISTING pod-agreed stop machinery (_stop_agreed's
+    # any-reduce), so every member stops at the same step, lands the
+    # mid-epoch checkpoint, and re-forms the larger pod together.
+    grow_state = {"fired": False, "t": 0.0, "joiners": []}
+    grow_stop = False  # the agreed stop was a grow, not a preemption
+    if cfg.elastic and senv is not None and getattr(senv, "members", ()):
+        grow_edir = elastic_lib.elastic_dir(cfg.log_dir)
+        grow_roster = {"attempt": senv.elastic_attempt,
+                       "members": list(senv.members)}
+
+        def _grow_pending() -> bool:
+            if not is_master:
+                return False
+            now = time.monotonic()
+            if now - grow_state["t"] < 2.0:
+                return grow_state["fired"]
+            grow_state["t"] = now
+            pend = elastic_lib.pending_joiners(grow_edir, grow_roster)
+            if pend and not grow_state["fired"]:
+                grow_state["fired"] = True
+                grow_state["joiners"] = pend
+                print(f"ELASTIC: host(s) {pend} filed a join request "
+                      "— stopping at the next pod-agreed step to "
+                      "re-form the pod (grow)", flush=True)
+            return grow_state["fired"]
+
+        base_stop_check = stop_check
+        grow_state["base"] = base_stop_check
+        stop_check = (lambda: (base_stop_check() if base_stop_check
+                               is not None else False)
+                      or _grow_pending())
+
+    def _grow_stop_agreed() -> bool:
+        """Pod-agreed CLASSIFICATION of an agreed stop: only the
+        master polls the elastic dir, so its verdict (grow vs
+        preemption) is broadcast — otherwise every other member would
+        classify the same stop as a preemption, tombstone 'preempted',
+        exit 75, and take the normal interpreter exit into a shutdown
+        barrier the exec-restarted master can never complete. A REAL
+        preemption (or the watchdog) that latched alongside the grow
+        request outranks it: exec-restarting into a rendezvous while
+        the scheduler's grace clock runs would turn a routine
+        preemption into a SIGKILL mid-rendezvous."""
+        if "base" not in grow_state:
+            # Grow polling not armed (non-elastic, or no roster): the
+            # stop is a plain preemption on every rank — no collective.
+            # The key is set identically pod-wide (cfg + roster), so
+            # entry into the broadcast below stays symmetric.
+            return False
+        base = grow_state.get("base")
+        local = 1 if (grow_state["fired"]
+                      and not (base is not None and base())) else 0
+        if jax.process_count() == 1:
+            return bool(local)
+        from jax.experimental import multihost_utils
+        out = multihost_utils.broadcast_one_to_all(
+            np.asarray([local], np.int32))
+        return bool(out[0])
 
     try:
         while epoch < cfg.epochs:
@@ -1694,10 +1982,38 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                         **_health_meta()},
                     keep_last_k=cfg.keep_last_k)
                 telem.phase("checkpoint", time.perf_counter() - t_ck)
-                telem.count("preempted")
+                # Classify the agreed stop POD-WIDE (the master's
+                # verdict, broadcast — it alone polls the join files):
+                # a real preemption or the watchdog outranks a grow
+                # stop. Every rank then takes the same ramp — skip the
+                # tombstone, report resize_grow, exec-restart — or
+                # none does.
+                grow_stop = _grow_stop_agreed()
+                if grow_stop:
+                    telem.count("pod_resize_grow")
+                    telem.pod_resized({
+                        "phase": "grow-stop", "epoch": epoch,
+                        "resume_step": interrupted_at,
+                        "from_processes": jax.process_count(),
+                        # The world the re-formed pod is headed for
+                        # (also the TB pod/resized marker value).
+                        "to_processes": (jax.process_count()
+                                         + len(grow_state["joiners"])),
+                        "joiners": grow_state["joiners"],
+                        "global_batch": global_batch,
+                    })
+                else:
+                    telem.count("preempted")
                 _end_telemetry_epoch(epoch, train_m, interrupted=True,
                                      step=interrupted_at)
-                if is_master:
+                if is_master and grow_stop:
+                    print("ELASTIC grow stop: checkpointed epoch "
+                          f"{epoch + 1} at step {interrupted_at}; "
+                          "re-forming the pod with the waiting "
+                          f"host(s) {grow_state['joiners']} (exit "
+                          f"{exitcodes.POD_RESIZE}, then rendezvous "
+                          "onto --resume)", flush=True)
+                elif is_master:
                     print("preemption signal: checkpointed epoch "
                           f"{epoch + 1} at step {interrupted_at}; "
                           "exiting cleanly (--resume continues from "
@@ -1787,23 +2103,39 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         # up cannot be vouched for; the last committed generation
         # stands.
         if pod is not None and not pod.degraded:
+            # Under --elastic the verdict may be an EXCLUSION: the
+            # survivors' re-formed roster only commits after their
+            # exec + rendezvous settle, so hold the exception long
+            # enough to cover that window — classifying the resulting
+            # gloo blow-up as an anonymous exception would cost the
+            # flapper its clear elastic-excluded tombstone.
             pod.wait_verdict(cfg.peer_deadline_secs
-                             + 2.0 * cfg.heartbeat_secs)
+                             + 2.0 * cfg.heartbeat_secs
+                             + (3.0 * cfg.elastic_settle_secs
+                                if cfg.elastic else 0.0))
         if pod is not None and pod.degraded:
-            err = exitcodes.PeerDeathError(
-                f"run exception attributed to a dead peer "
-                f"({type(exc).__name__}: {exc})", verdict=pod.verdict)
+            # Kind-aware classification: the same verdict semantics as
+            # an in-loop detection — elastic continue raises the
+            # RESIZE error (survivors re-form), an exclusion raises
+            # the tombstoned stop, a plain death the retryable 87.
+            err = pod.error_for_verdict(
+                prefix=(f"run exception attributed to pod "
+                        f"degradation ({type(exc).__name__}: {exc}) "
+                        "— "))
             _pod_death_exit(cfg, err, pod, telem, epoch, topo_meta,
                             {"best_top1": best_top1,
                              "best_top5": best_top5,
                              "best_epoch": best_epoch}, is_master)
             raise err from exc
         raise
-    if preempted and pod is not None:
+    if preempted and pod is not None and not grow_stop:
         # Clean checkpoint-and-exit still classifies itself for the
         # peers' monitors (and the requeue wrapper reads the matching
         # exit code from __main__): preemption and the watchdog's
-        # clean path are both retryable.
+        # clean path are both retryable. A GROW stop writes no
+        # tombstone — every member departs on a done-beat and
+        # immediately re-forms; a tombstone would race the re-formed
+        # monitors as a fresh fatal.
         if watchdog is not None and watchdog.fired:
             pod.tombstone("watchdog-stall", exitcodes.PREEMPTED,
                           detail="stalled steps; clean "
@@ -1841,6 +2173,8 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
             "input_wait_alert": last_input_alert[0],
             "clock_skew_s": last_clock_skew[0],
             "degraded": bool(pod is not None and pod.degraded),
+            "world_size": jax.process_count(),
+            "launched_world_size": launched_world,
             "health": (monitor.snapshot()
                        if monitor is not None else None),
         })
@@ -1848,6 +2182,10 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                "best_epoch": best_epoch, "total_minutes": total_min,
                "final_train": train_m, "final_val": val_m,
                "preempted": preempted, "rollbacks": rollbacks,
+               # The agreed stop was a GROW: __main__ maps this to the
+               # POD_RESIZE exit (or exec-restarts straight into the
+               # rendezvous) instead of the preemption code.
+               "resize_grow": grow_stop,
                "ckpt_commit_failures": ckpt_commit_failures}
     telem.run_end({"best_top1": best_top1, "best_epoch": best_epoch,
                    "total_minutes": round(total_min, 3),

@@ -1,24 +1,38 @@
 #!/bin/bash
 # Requeue wrapper: run the training command; when it dies with a
 # RETRYABLE exit code (resilience/exitcodes.py — preemption 75,
-# watchdog hard-exit 86, deadman peer-death 87, storage outage 88),
-# restart it with --resume after an exponential backoff, bounded by a
-# restart budget. Non-retryable codes (config errors, reproducible
-# faults) and an exhausted budget exit immediately with the original
-# code, so a broken invocation never crash-loops.
+# watchdog hard-exit 86, deadman peer-death 87, storage outage 88,
+# elastic pod-resize 89, elastic exclusion 90), restart it with
+# --resume after an exponential backoff, bounded by a restart budget.
+# Non-retryable codes (config errors, reproducible faults) and an
+# exhausted budget exit immediately with the original code, so a
+# broken invocation never crash-loops.
+#
+# The restart budget is PER INCIDENT STREAK, not per run (mirroring
+# the engine's rollback give-up semantics): an attempt that made clean
+# progress — a newly COMPLETED epoch, read from the resume meta's
+# "epoch" field (<ckpt-dir>/last_meta.json) — resets the consumed
+# budget, so three isolated recoveries across a long run don't kill a
+# healthy job on the fourth.
 #
 # Used as the per-task command under both launchers (slurm_tpu.sh's
 # srun line, tpu_pod.sh's worker fan-out): every host of a degraded
 # pod exits retryable within seconds of a peer death (the deadman
 # makes the failure pod-wide and fast), so all tasks fall into this
 # loop together, back off, and re-rendezvous onto --resume — the
-# whole-pod requeue without scheduler support.
+# whole-pod requeue without scheduler support. With --elastic the
+# relaunch re-forms whatever roster shows up (shrink or grow).
 #
 # Usage: requeue.sh <command...>
 # Env knobs:
-#   IMAGENT_RESTART_BUDGET   max restarts (default 3)
+#   IMAGENT_RESTART_BUDGET   max restarts per no-progress streak
+#                            (default 3)
 #   IMAGENT_RESTART_BACKOFF  base backoff seconds, doubling per
 #                            restart, capped at 300 (default 5)
+#   IMAGENT_CKPT_DIR         where to read last_meta.json for the
+#                            progress reset (default: the --ckpt-dir
+#                            argument in the command, else
+#                            "checkpoints")
 #   IMAGENT_RETRYABLE_CODES  space-separated override of the retryable
 #                            set. The default below is a literal (this
 #                            script must work when Python cannot even
@@ -29,7 +43,33 @@ set -u
 
 BUDGET="${IMAGENT_RESTART_BUDGET:-3}"
 BACKOFF="${IMAGENT_RESTART_BACKOFF:-5}"
-RETRYABLE="${IMAGENT_RETRYABLE_CODES:-75 86 87 88}"
+RETRYABLE="${IMAGENT_RETRYABLE_CODES:-75 86 87 88 89 90}"
+
+# Resolve the checkpoint dir for the progress probe: explicit env, else
+# the command's own --ckpt-dir (last occurrence wins, both = and
+# space-separated forms), else the config default.
+ckpt_dir="${IMAGENT_CKPT_DIR:-}"
+if [ -z "${ckpt_dir}" ]; then
+  ckpt_dir="checkpoints"
+  prev=""
+  for arg in "$@"; do
+    case "${arg}" in
+      --ckpt-dir=*) ckpt_dir="${arg#--ckpt-dir=}" ;;
+    esac
+    [ "${prev}" = "--ckpt-dir" ] && ckpt_dir="${arg}"
+    prev="${arg}"
+  done
+fi
+
+progress_epoch() {
+  # The "epoch" field of the resume meta sidecar, no Python required.
+  # Missing/unreadable/torn file prints nothing; callers default.
+  sed -n 's/.*"epoch"[[:space:]]*:[[:space:]]*\(-\{0,1\}[0-9][0-9]*\).*/\1/p' \
+    "${ckpt_dir}/last_meta.json" 2>/dev/null | head -n 1
+}
+
+last_epoch="$(progress_epoch)"
+last_epoch="${last_epoch:--1000}"
 
 attempt=0
 while :; do
@@ -50,6 +90,17 @@ while :; do
     echo "requeue: exit ${rc} is not retryable; giving up" >&2
     exit "${rc}"
   fi
+  cur_epoch="$(progress_epoch)"
+  cur_epoch="${cur_epoch:--1000}"
+  if [ "${cur_epoch}" -gt "${last_epoch}" ]; then
+    # Clean progress since the last probe: a newly completed epoch in
+    # the resume meta. The incident streak is over — reset the budget.
+    if [ "${attempt}" -gt 0 ]; then
+      echo "requeue: clean progress (epoch $((cur_epoch + 1)) complete per resume meta); restart budget reset" >&2
+    fi
+    attempt=0
+  fi
+  last_epoch="${cur_epoch}"
   if [ "${attempt}" -ge "${BUDGET}" ]; then
     echo "requeue: restart budget (${BUDGET}) exhausted after exit ${rc}" >&2
     exit "${rc}"

@@ -100,12 +100,30 @@ class DeadmanMonitor:
 
     def __init__(self, hb_dir: str, rank: int, world: int,
                  deadline_secs: float, escalate_secs: float | None = None,
-                 tombstone_cb=None, out=None, _exit=os._exit):
+                 tombstone_cb=None, out=None, _exit=os._exit,
+                 peers: list[int] | None = None,
+                 continue_on_death: bool = False,
+                 elastic_dir: str | None = None,
+                 elastic_attempt: int = 0):
         if deadline_secs <= 0:
             raise ValueError("peer deadline must be positive")
         self.hb_dir = hb_dir
         self.rank = int(rank)
         self.world = int(world)
+        # Elastic pod: ``peers`` (launched ranks of the current roster,
+        # minus self) replaces the dense range(world) watch set — a
+        # shrunk pod must not judge the slot it already resized away.
+        # ``continue_on_death`` turns the death verdict into CONTINUE
+        # (exitcodes.PodResizeError: survivors re-form a smaller mesh
+        # instead of requeueing whole). ``elastic_dir``/``attempt``
+        # arm the roster watch: a roster committed at a NEWER attempt
+        # WITHOUT this rank means the pod re-formed without us (we
+        # flapped past the deadline and returned) — the EXCLUDED
+        # verdict, a fatal stop with a clear tombstone, never a
+        # split-brain.
+        self.continue_on_death = bool(continue_on_death)
+        self._elastic_dir = elastic_dir
+        self._elastic_attempt = int(elastic_attempt)
         self.deadline = float(deadline_secs)
         self.degraded = False
         self.verdict: dict | None = None
@@ -124,9 +142,10 @@ class DeadmanMonitor:
         # Per-peer observation state: last record signature, the
         # monotonic instant it last changed, whether we ever saw it
         # change (alive this run), and the clean-departure marker.
-        self._peers = {r: {"sig": None, "changed_at": None,
-                           "alive": False, "done": False}
-                       for r in range(self.world) if r != self.rank}
+        watch = (peers if peers is not None else range(self.world))
+        self._peers = {int(r): {"sig": None, "changed_at": None,
+                                "alive": False, "done": False}
+                       for r in watch if int(r) != self.rank}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -163,11 +182,32 @@ class DeadmanMonitor:
         if not self.degraded:
             return
         self.ack()
-        v = dict(self.verdict or {})
         salvage = None
         if state is not None:
             salvage = {"state": state, "epoch": int(epoch),
                        "resume_step": int(resume_step)}
+        raise self.error_for_verdict(salvage=salvage)
+
+    def error_for_verdict(self, salvage: dict | None = None,
+                          prefix: str = ""
+                          ) -> "exitcodes.PeerDeathError":
+        """Build (not raise) the kind-appropriate exception for the
+        current verdict — shared by ``raise_if_degraded`` and the
+        engine's exception-path classifier (a one-sided collective
+        blow-up attributed to pod degradation must carry the SAME
+        verdict semantics as an in-loop detection)."""
+        v = dict(self.verdict or {})
+        if v.get("excluded"):
+            # The pod re-formed WITHOUT this host (it flapped past the
+            # deadline and came back): stop NOW — the committed roster
+            # is the pod; our updates can never land.
+            return exitcodes.ElasticExcludedError(
+                f"{prefix}the elastic roster (attempt "
+                f"{v.get('roster_attempt')}) committed without this "
+                f"host (members {v.get('members')}) — it was declared "
+                "dead and the survivors re-formed; exiting with a "
+                "tombstone (a relaunch rejoins as a grow request)",
+                verdict=v)
         ts = v.get("tombstone") or {}
         why = (f"tombstone: {ts.get('reason', '?')}" if ts
                else f"heartbeat stale {v.get('stale_for_s', 0.0):.1f}s "
@@ -177,19 +217,37 @@ class DeadmanMonitor:
         # never rejoin a requeued rendezvous, so exiting retryable
         # here would only burn the restart budget on timeouts.
         code = self.exit_code_for_verdict()
+        if code == exitcodes.POD_RESIZE:
+            # Elastic CONTINUE: the death is real, but the pod keeps
+            # training — survivors land the salvage and re-initialize
+            # over the survivor roster instead of requeueing whole.
+            return exitcodes.PodResizeError(
+                f"{prefix}pod peer host {v.get('peer')} is dead "
+                f"({why}) — elastic continue: survivors re-form a "
+                "smaller mesh", verdict=v, salvage=salvage)
         if code != exitcodes.PEER_DEAD:
             why += " — NON-retryable on the peer; adopting its verdict"
-        raise exitcodes.PeerDeathError(
-            f"pod peer host {v.get('peer')} is dead ({why})",
+        return exitcodes.PeerDeathError(
+            f"{prefix}pod peer host {v.get('peer')} is dead ({why})",
             verdict=v, salvage=salvage, exit_code=code)
 
     def exit_code_for_verdict(self) -> int:
         """The code this host should die with for the current verdict:
-        PEER_DEAD (retryable) normally; the peer's own classification
-        when its tombstone declared the death NON-retryable."""
-        ts = (self.verdict or {}).get("tombstone") or {}
+        PEER_DEAD (retryable) normally; POD_RESIZE when elastic
+        continuation is armed (the escalation hard-exit then still
+        re-enters the shrink path through the requeue wrapper);
+        ELASTIC_EXCLUDED for the re-formed-without-us verdict; the
+        peer's own classification when its tombstone declared the
+        death NON-retryable (elastic continuation does NOT override
+        that — a reproducing fault must not silently shrink the pod)."""
+        v = self.verdict or {}
+        if v.get("excluded"):
+            return exitcodes.ELASTIC_EXCLUDED
+        ts = v.get("tombstone") or {}
         if ts.get("retryable") is False:
             return int(ts.get("exit_code", exitcodes.FATAL_EXCEPTION))
+        if self.continue_on_death:
+            return exitcodes.POD_RESIZE
         return exitcodes.PEER_DEAD
 
     def wait_verdict(self, timeout: float) -> dict | None:
@@ -218,6 +276,15 @@ class DeadmanMonitor:
 
     def _scan(self) -> None:
         now = time.monotonic()
+        if self._elastic_dir is not None:
+            from imagent_tpu import elastic
+            ros = elastic.read_roster(self._elastic_dir)
+            if (ros is not None
+                    and int(ros.get("attempt", 0)) > self._elastic_attempt
+                    and self.rank not in
+                    [int(r) for r in ros.get("members", ())]):
+                self._trip_excluded(ros, now)
+                return
         for r, st in self._peers.items():
             if st["done"]:
                 continue
@@ -243,6 +310,34 @@ class DeadmanMonitor:
                 self._trip(r, "stale", st, now, None)
                 return
 
+    def _trip_excluded(self, roster: dict, now: float) -> None:
+        """The pod committed a newer roster WITHOUT this rank: it was
+        judged dead (heartbeat flap past the deadline) and the
+        survivors re-formed. Same trip machinery as a peer death —
+        degraded flag, escalation window, stack dump — but the verdict
+        is EXCLUDED: this host must stop with a clear tombstone; its
+        old session's collectives are gone and nothing it computes can
+        ever land (the no-split-brain half of the hb.flap drill)."""
+        self.verdict = {
+            "excluded": True, "reason": "excluded",
+            "roster_attempt": int(roster.get("attempt", 0)),
+            "members": [int(r) for r in roster.get("members", ())],
+            "t_detect": round(time.time(), 3),
+        }
+        self.degraded = True
+        self._escalate_at = now + self._escalate_window
+        trace_mod.instant("pod/excluded", cat="pod",
+                          roster_attempt=self.verdict["roster_attempt"])
+        out = self._out if self._out is not None else sys.stderr
+        print(f"DEADMAN: host {self.rank} is EXCLUDED from the elastic "
+              f"roster (attempt {self.verdict['roster_attempt']}, "
+              f"members {self.verdict['members']}) — the pod re-formed "
+              "without us while our heartbeat was stale. Refusing all "
+              "further work and exiting with a tombstone (code "
+              f"{exitcodes.ELASTIC_EXCLUDED}); a relaunch rejoins as "
+              "a grow request", file=out, flush=True)
+        dump_all_stacks(self._out)
+
     def _trip(self, peer: int, reason: str, st: dict, now: float,
               tombstone: dict | None) -> None:
         age = (now - st["changed_at"]) if st["changed_at"] is not None \
@@ -267,12 +362,15 @@ class DeadmanMonitor:
             ts = (f"; tombstone reason={tombstone.get('reason')} "
                   f"exit_code={tombstone.get('exit_code')} "
                   f"retryable={tombstone.get('retryable')}")
+        code = self.exit_code_for_verdict()
+        plan = ("continuing ELASTIC on the survivors (resize, code "
+                f"{code})" if code == exitcodes.POD_RESIZE else
+                f"exiting (code {code})")
         print(f"DEADMAN: peer host {peer} declared dead ({reason}; "
               f"heartbeat stale {age:.1f}s, deadline "
               f"{self.deadline:.1f}s{ts}) — pod DEGRADED: refusing new "
-              "collectives, landing the emergency snapshot, exiting "
-              f"retryable (code {exitcodes.PEER_DEAD})",
-              file=out, flush=True)
+              "collectives, landing the emergency snapshot, "
+              f"{plan}", file=out, flush=True)
         dump_all_stacks(self._out)
 
     def _watch(self, poll: float) -> None:
@@ -348,10 +446,26 @@ class PodHeartbeat:
     def __init__(self, run_dir: str, rank: int, world: int,
                  deadline_secs: float, interval_secs: float = 2.0,
                  escalate_secs: float | None = None, out=None,
-                 _exit=os._exit):
+                 _exit=os._exit, members: list[int] | None = None,
+                 continue_on_death: bool = False,
+                 elastic_dir: str | None = None,
+                 elastic_attempt: int = 0):
         self.dir = heartbeat.heartbeat_dir(run_dir)
         self.rank = int(rank)
         self.world = int(world)
+        # Elastic pod: ``rank`` is the LAUNCHED rank (the stable host
+        # slot — heartbeat/tombstone identity survives re-numbering),
+        # ``members`` the current roster's launched ranks (self
+        # included); the monitor watches only those peers and the
+        # engine picks the salvage lander as the lowest surviving
+        # member. ``escalate_secs`` honors the
+        # IMAGENT_DEADMAN_ESCALATE_SECS env override (drills).
+        self.members = sorted(int(r) for r in members) \
+            if members is not None else list(range(self.world))
+        if escalate_secs is None:
+            raw = os.environ.get("IMAGENT_DEADMAN_ESCALATE_SECS", "")
+            if raw:
+                escalate_secs = float(raw)
         # Optional pre-tombstone hook: callable(reason, exit_code,
         # detail="") -> path-or-None. The engine wires the flight
         # recorder's flush here, so EVERY deliberate fatal ramp (the
@@ -365,9 +479,15 @@ class PodHeartbeat:
             self.dir, rank, world, deadline_secs,
             escalate_secs=escalate_secs,
             tombstone_cb=lambda code: self.tombstone(
-                "peer-dead", code,
+                ("elastic-excluded"
+                 if code == exitcodes.ELASTIC_EXCLUDED else
+                 "pod-resize" if code == exitcodes.POD_RESIZE
+                 else "peer-dead"), code,
                 detail="deadman escalation: main thread wedged"),
-            out=out, _exit=_exit)
+            out=out, _exit=_exit,
+            peers=[r for r in self.members if r != self.rank],
+            continue_on_death=continue_on_death,
+            elastic_dir=elastic_dir, elastic_attempt=elastic_attempt)
 
     def start(self) -> None:
         self.writer.start()
@@ -395,6 +515,11 @@ class PodHeartbeat:
 
     def wait_verdict(self, timeout: float) -> dict | None:
         return self.monitor.wait_verdict(timeout)
+
+    def error_for_verdict(self, salvage: dict | None = None,
+                          prefix: str = ""):
+        return self.monitor.error_for_verdict(salvage=salvage,
+                                              prefix=prefix)
 
     def max_peer_staleness(self) -> float:
         return self.monitor.max_peer_staleness()

@@ -40,6 +40,14 @@ PEER_DEAD = 87          # deadman: a pod peer's heartbeat died; the pod
                         # must requeue together onto --resume
 STORAGE_OUTAGE = 88     # checkpoint storage dead past the retry budget;
                         # previous generation intact
+POD_RESIZE = 89         # elastic continue: the pod is re-forming at a
+                        # different world size (shrink after a peer
+                        # death, or grow when a waiting host asked to
+                        # join); relaunch re-rendezvouses onto --resume
+ELASTIC_EXCLUDED = 90   # this host was excluded from the elastic pod
+                        # roster (declared dead and returned, or joined
+                        # after the roster committed); a relaunch
+                        # rejoins as a standing grow request
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,6 +79,14 @@ REGISTRY: tuple[ExitCode, ...] = (
     ExitCode(STORAGE_OUTAGE, "storage-outage", True,
              "checkpoint storage unwritable past the bounded retries; "
              "the previous generation is intact"),
+    ExitCode(POD_RESIZE, "pod-resize", True,
+             "elastic resize in progress (shrink-to-survive or "
+             "grow-on-requeue); relaunch re-rendezvouses the roster "
+             "onto --resume"),
+    ExitCode(ELASTIC_EXCLUDED, "elastic-excluded", True,
+             "excluded from the elastic pod roster (flapped past the "
+             "deadline or joined late); relaunching files a standing "
+             "grow request"),
 )
 
 _BY_CODE = {e.code: e for e in REGISTRY}
@@ -137,6 +153,43 @@ class PeerDeathError(FatalRunError):
         self.salvage = salvage
         if exit_code is not None:
             self.exit_code = int(exit_code)  # instance override
+
+
+class PodResizeError(PeerDeathError):
+    """A peer died with elastic continuation armed (``--elastic``): the
+    DEADMAN verdict is CONTINUE, not die — the survivors land the
+    salvage snapshot, depart the dead session cleanly (done-beat, NO
+    tombstone: this is not a death), and re-initialize as a smaller
+    mesh over the pod-agreed survivor roster
+    (``imagent_tpu/elastic.py`` rendezvous; ``__main__`` exec-restarts
+    the process so ``jax.distributed`` re-initializes cleanly). Also
+    raised — with ``grow=True`` and no verdict — at the pod-agreed stop
+    when a waiting host filed a join request: the whole pod re-forms at
+    the larger world size the same way."""
+
+    exit_code = POD_RESIZE
+    reason = "pod-resize"
+
+    def __init__(self, msg: str, verdict: dict | None = None,
+                 salvage: dict | None = None,
+                 exit_code: int | None = None, grow: bool = False):
+        super().__init__(msg, verdict=verdict, salvage=salvage,
+                         exit_code=exit_code)
+        self.grow = bool(grow)
+
+
+class ElasticExcludedError(PeerDeathError):
+    """The elastic roster committed WITHOUT this host — it was declared
+    dead (heartbeat flap past the deadline) and the survivors re-formed,
+    or it joined the rendezvous after the settle window closed. The
+    host must STOP immediately (its updates can never land — the old
+    session's collectives are gone) and exit with a clear tombstone; a
+    relaunch rejoins as a standing grow request the running pod admits
+    at its next pod-agreed stop. No split-brain: the roster publication
+    is the atomic commit point — a host is a member or it is not."""
+
+    exit_code = ELASTIC_EXCLUDED
+    reason = "elastic-excluded"
 
 
 class StorageOutageError(FatalRunError):

@@ -59,6 +59,12 @@ Registered fault points (grep for ``faultinject.fire``):
   while the process keeps running — the unobservable-host drill: peers
   must (by design) declare this host dead, because a host that cannot
   prove liveness is indistinguishable from a dead one.
+* ``hb.flap`` (resilience/heartbeat): the writer goes silent for
+  ``secs`` (default 5) and then RESUMES — the late-returning-host race
+  the elastic resize path must survive: by the time the flapper beats
+  again the peers have either committed the smaller roster (the
+  flapper finds itself EXCLUDED and exits with a clear tombstone,
+  resilience/deadman.py) or never resized; no split-brain.
 
 Cost discipline: when nothing is configured, ``fire`` is one falsy
 check on a module dict — safe to call per step / per file in hot

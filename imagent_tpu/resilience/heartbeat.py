@@ -109,6 +109,7 @@ class HeartbeatWriter:
         self._state = {"epoch": -1, "step": -1, "phase": "init"}
         self._seq = 0
         self._frozen = False
+        self._flap_until = 0.0  # hb.flap: silent until this instant
         self._write_errors = 0
         self._tombstoned = False
         self._lock = threading.Lock()
@@ -151,6 +152,24 @@ class HeartbeatWriter:
             print("FAULT hb.stale: heartbeat writer frozen (process "
                   "keeps running)", flush=True)
             return
+        f = faultinject.fire("hb.flap")
+        if f is not None:
+            # The late-returning-host race: the writer goes silent past
+            # the deadline, then RESUMES beating — by then the peers
+            # must either have committed to the smaller roster (this
+            # host finds itself excluded and tombstones) or never have
+            # resized at all; anything in between is a split brain
+            # (resilience/deadman.py::_trip_excluded).
+            secs = float(f.get("secs", 5.0))
+            self._flap_until = time.monotonic() + secs
+            print(f"FAULT hb.flap: heartbeat writer silent for "
+                  f"{secs:g}s, then resuming", flush=True)
+        if self._flap_until:
+            if time.monotonic() < self._flap_until:
+                return
+            self._flap_until = 0.0
+            print("FAULT hb.flap: heartbeat writer resumed beating",
+                  flush=True)
         with self._lock:
             payload = {"rank": self.rank, "pid": os.getpid(),
                        "seq": self._seq, "t": time.time(),

@@ -131,6 +131,31 @@ def _scan_hosts(run_dir: str, now: float) -> list[str]:
     return lines
 
 
+def describe_checkpoint(ckpt_dir: str) -> str | None:
+    """One line describing the resume point in ``ckpt_dir`` — and
+    crucially WHAT KIND it is: an emergency-salvage snapshot (landed by
+    a degraded-pod exit, ``emergency`` meta flag) and a mid-epoch
+    frontier (``resume_step``) are called out explicitly, instead of
+    being indistinguishable from a clean end-of-epoch LAST without
+    reading the JSON by hand. Reads only the advisory
+    ``last_meta.json`` sidecar (jax-free); None when absent."""
+    meta = read_json(os.path.join(ckpt_dir, "last_meta.json"))
+    if meta is None:
+        return None
+    epoch = int(meta.get("epoch", -1))
+    step = int(meta.get("resume_step", 0) or 0)
+    pods = int(meta.get("process_count", 0) or 0)
+    by = f" (written by a {pods}-host pod)" if pods else ""
+    if int(meta.get("emergency", 0) or 0):
+        return (f"checkpoint 'last': EMERGENCY salvage — resumes "
+                f"epoch {epoch + 2} step {step}{by}; landed by the "
+                "degraded-pod exit, --resume restores it")
+    if step > 0:
+        return (f"checkpoint 'last': mid-epoch frontier — resumes "
+                f"epoch {epoch + 2} step {step}{by}")
+    return f"checkpoint 'last': epoch {epoch + 1} complete{by}"
+
+
 def _last_epoch_record(run_dir: str) -> tuple[dict | None, dict | None,
                                               list[dict]]:
     """(last epoch record, run_start, recent health_anomaly events)
@@ -152,9 +177,12 @@ def _last_epoch_record(run_dir: str) -> tuple[dict | None, dict | None,
     return epoch_rec, run_start, anomalies[-3:]
 
 
-def render(run_dir: str, now: float | None = None) -> str:
+def render(run_dir: str, now: float | None = None,
+           ckpt_dir: str | None = None) -> str:
     """The one-screen pod view. Every input is optional — a run that
-    never armed heartbeats still renders its status + telemetry."""
+    never armed heartbeats still renders its status + telemetry.
+    ``ckpt_dir`` (default ``<run_dir>/checkpoints``): where to look
+    for the resume-point sidecar (salvage/mid-epoch surfacing)."""
     now = time.time() if now is None else now
     st = read_status(run_dir)
     epoch_rec, run_start, anomalies = _last_epoch_record(run_dir)
@@ -198,6 +226,15 @@ def render(run_dir: str, now: float | None = None) -> str:
                 f"streak {iw.get('streak', 1)}) — host "
                 f"{iw.get('worst_host', '?')} slowest "
                 f"({_fmt(iw.get('worst_host_wait_s'), '.1f')}s)")
+        world = st.get("world_size")
+        launched = st.get("launched_world_size")
+        if world and launched and int(world) != int(launched):
+            # A silently-shrunk (or over-grown) pod must be one glance
+            # away: the ELASTIC resize left fewer hosts than launched.
+            lines.append(
+                f"pod: ** ELASTIC RESIZED — running on {world} of "
+                f"{launched} launched host(s) ** (grad-accum absorbs "
+                "the difference under the --global-batch contract)")
         skew = st.get("clock_skew_s")
         if skew is not None:
             # Measured at the epoch-boundary sync point (the telemetry
@@ -226,6 +263,10 @@ def render(run_dir: str, now: float | None = None) -> str:
                 f"hbm: {_fmt(hbm.get('peak_bytes_in_use', 0) / 1e9, '.2f')}"
                 f" GB peak"
                 + (f" / {_fmt(limit / 1e9, '.2f')} GB" if limit else ""))
+    ck = describe_checkpoint(ckpt_dir if ckpt_dir is not None
+                             else os.path.join(run_dir, "checkpoints"))
+    if ck:
+        lines.append(ck)
     hosts = _scan_hosts(run_dir, now)
     if hosts:
         lines.append("hosts:")
@@ -247,12 +288,16 @@ def main(argv=None) -> int:
     p.add_argument("run_dir", help="the run's --log-dir")
     p.add_argument("--watch", type=float, default=0.0, metavar="SECS",
                    help="refresh every SECS seconds (0 = render once)")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="the run's --ckpt-dir, for the resume-point "
+                        "line (emergency-salvage / mid-epoch "
+                        "surfacing); default <run_dir>/checkpoints")
     ns = p.parse_args(argv)
     if not os.path.isdir(ns.run_dir):
         print(f"no such run dir: {ns.run_dir}", file=sys.stderr)
         return 2
     while True:
-        out = render(ns.run_dir)
+        out = render(ns.run_dir, ckpt_dir=ns.ckpt_dir)
         if ns.watch > 0:
             print("\033[2J\033[H" + out, flush=True)  # clear + home
             try:

@@ -172,6 +172,19 @@ class TelemetrySession:
         if self.writer is not None:
             self.writer.write("health_anomaly", info)
 
+    def pod_resized(self, info: dict) -> None:
+        """An elastic resize took effect (or a grow stop is about to
+        re-form the pod): written as a ``pod_resized`` event carrying
+        the world-size transition and the lr/grad-accum adjustment the
+        fixed --global-batch contract implies, plus a TB marker. Local
+        bookkeeping only — the resize itself was already pod-agreed
+        (the committed roster / the any-reduced grow stop)."""
+        if self.writer is not None:
+            self.writer.write("pod_resized", info)
+        if self.logger is not None:
+            self.logger.pod_resized(int(info.get("epoch", 0)),
+                                    int(info.get("to_processes", 0)))
+
     def pod_degraded(self, info: dict) -> None:
         """The deadman's detection verdict: a peer died and this run is
         exiting retryable. Written as a ``pod_degraded`` event (the

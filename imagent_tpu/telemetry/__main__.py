@@ -48,8 +48,13 @@ def _cell(v, width: int, spec: str = "") -> str:
         return str(v).rjust(width)
 
 
-def summarize(run_dir: str) -> str:
-    """The per-epoch table (one string, newline-joined)."""
+def summarize(run_dir: str, ckpt_dir: str | None = None) -> str:
+    """The per-epoch table (one string, newline-joined).
+
+    ``ckpt_dir`` (default ``<run_dir>/checkpoints``): when a resume
+    meta exists there, the table closes with the resume-point line —
+    an emergency-salvage snapshot or mid-epoch frontier is called out
+    explicitly instead of masquerading as a clean end-of-epoch LAST."""
     path = os.path.join(run_dir, FILENAME)
     if not os.path.isfile(path):
         return f"no {FILENAME} under {run_dir}"
@@ -73,7 +78,24 @@ def summarize(run_dir: str) -> str:
             notable.append(
                 f"  pod_degraded: peer {rec.get('peer')} "
                 f"({rec.get('reason')}) at epoch "
-                f"{int(rec.get('epoch', 0)) + 1}")
+                f"{int(rec.get('epoch', 0)) + 1}"
+                + (" [elastic continue]" if rec.get("continue")
+                   else ""))
+        elif ev == "pod_resized":
+            if rec.get("phase") == "grow-stop":
+                notable.append(
+                    f"  pod_resized: grow stop at epoch "
+                    f"{int(rec.get('epoch', 0)) + 1} step "
+                    f"{rec.get('resume_step')} — joiners "
+                    f"{rec.get('joiners')}")
+            else:
+                notable.append(
+                    f"  pod_resized: {rec.get('from_processes')} -> "
+                    f"{rec.get('to_processes')} host(s) at epoch "
+                    f"{int(rec.get('epoch', 0)) + 1} — global_batch "
+                    f"{rec.get('global_batch')}, grad_accum "
+                    f"{rec.get('grad_accum_prev')} -> "
+                    f"{rec.get('grad_accum')}, lr {rec.get('lr')}")
     # The trace columns appear only when the run was traced — an
     # untraced run's table stays byte-identical to the pre-trace
     # format (both pinned by golden tests).
@@ -141,6 +163,11 @@ def summarize(run_dir: str) -> str:
             f"(epoch {int(run_end.get('best_epoch', -1)) + 1}), "
             f"{run_end.get('total_minutes', 0.0)} min, rollbacks "
             f"{run_end.get('rollbacks', 0)}")
+    from imagent_tpu.status import describe_checkpoint
+    ck = describe_checkpoint(ckpt_dir if ckpt_dir is not None
+                             else os.path.join(run_dir, "checkpoints"))
+    if ck:
+        lines.append(ck)
     return "\n".join(lines)
 
 
@@ -192,6 +219,10 @@ def main(argv=None) -> int:
     ps = sub.add_parser("summarize",
                         help="per-epoch goodput/health table")
     ps.add_argument("run_dir", help="the run's --log-dir")
+    ps.add_argument("--ckpt-dir", default=None,
+                    help="the run's --ckpt-dir, for the resume-point "
+                         "line (emergency-salvage / mid-epoch "
+                         "surfacing); default <run_dir>/checkpoints")
     pt = sub.add_parser(
         "trace",
         help="merge per-rank trace files into a skew-corrected "
@@ -204,7 +235,7 @@ def main(argv=None) -> int:
                     help="also print the N longest spans as text")
     ns = p.parse_args(argv)
     if ns.cmd == "summarize":
-        print(summarize(ns.run_dir), flush=True)
+        print(summarize(ns.run_dir, ckpt_dir=ns.ckpt_dir), flush=True)
         return 0
     if ns.cmd == "trace":
         return merge_trace(ns.run_dir, ns.out, ns.top)

@@ -153,7 +153,23 @@ class TrainLogger:
             # declared dead (or a deadline tuned too tight).
             w.add_scalar("pod/hb_peer_staleness_s",
                          counters["hb_peer_staleness_s"], epoch)
+        if "world_size" in counters:
+            # Continuous world-size series: a pod that silently shrank
+            # (elastic continue) is visible as a step down — paired
+            # with the pod/resized marker and the status CLI line.
+            w.add_scalar("pod/world_size", counters["world_size"],
+                         epoch)
         w.flush()
+
+    def pod_resized(self, epoch: int, world: int) -> None:
+        """Marker for an elastic resize: the pod re-formed at ``world``
+        hosts at this epoch (detail in telemetry.jsonl's
+        ``pod_resized`` event; the continuous ``pod/world_size``
+        series rides the per-epoch counters)."""
+        if self.writer is None:
+            return
+        self.writer.add_scalar("pod/resized", float(world), epoch)
+        self.writer.flush()
 
     def pod_degraded(self, epoch: int) -> None:
         """Marker series for the deadman verdict: the run lost a peer

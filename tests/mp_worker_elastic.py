@@ -1,0 +1,114 @@
+"""Elastic-pod acceptance drill worker (REAL OS processes through the
+REAL CLI — ``imagent_tpu.__main__`` — so the exec-restart resize path
+is exactly what production runs). Phases via ``IMAGENT_ELASTIC_PHASE``:
+
+``kill`` (the ROADMAP item-3 bar): a 4-process pod trains epoch 0 with
+the deadman armed and the fixed ``--global-batch 12`` contract
+(batch 1 x 4 hosts x accum 3). At step 3, rank 2 hard-dies via
+``host.die`` while the survivors' ``stall-step`` holds them out of the
+next psum. Each survivor's deadman must return the CONTINUE verdict
+(``PodResizeError``), the lowest survivor must land the emergency
+salvage with ``emergency=1`` meta, and every survivor must
+exec-restart into the filesystem rendezvous, re-form a 3-host mesh on
+a fresh coordinator port, restore the salvage onto it (``pod_resized``
+4→3, accum 3→4, lr unchanged), re-open its sample stream at (epoch 0,
+step 3) with shards rebalanced over 3 hosts, finish the epoch, and
+exit 0. (A COORDINATOR death is different: the XLA coordination
+client hard-aborts every survivor before any Python runs — that case
+recovers through the relaunch rendezvous instead, see OPERATIONS.)
+
+``resume``: a fresh 4-process pod (the replacement host arrived)
+``--resume``s — restores the 3-world checkpoint onto 4 hosts
+(``pod_resized`` 3→4, accum 4→3) and trains epoch 1 to completion.
+
+``flap``: 3-process pod; rank 0's — the COORDINATOR's — heartbeat goes
+silent past the deadline (``hb.flap``) then RESUMES. The survivors
+(ranks 1, 2) must resize to a 2-host pod (salvage landed by rank 1,
+the lowest survivor — a genuinely non-zero process index, the
+``any_rank`` lander path) and complete; the returned flapper must find
+itself EXCLUDED from the committed roster and exit 90 with a clear
+``elastic-excluded`` tombstone — never a split brain. (The flapper
+keeps its own in-process coordination service, so it lives long
+enough to classify itself; the survivors' ``stall-step`` at step 0
+holds them at a common frontier while the freeze crosses the
+deadline.)
+
+``reference``: the uninterrupted run the drill's loss is compared
+against (same seed/contract, epochs via IMAGENT_ELASTIC_EPOCHS).
+
+Usage: python mp_worker_elastic.py <rank> <port> <world>
+(scratch via IMAGENT_MP_SCRATCH; sample trace via the inherited
+IMAGENT_SAMPLE_TRACE, world-stamped per record so the parent can
+separate the 4-host prefix from the 3-host continuation).
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    world = int(sys.argv[3])
+    scratch = os.environ["IMAGENT_MP_SCRATCH"]
+    phase = os.environ.get("IMAGENT_ELASTIC_PHASE", "kill")
+    epochs = os.environ.get("IMAGENT_ELASTIC_EPOCHS", "1")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1")
+    os.environ.update({
+        "SLURM_JOB_NUM_NODES": str(world),
+        "SLURM_NODEID": str(rank),
+        "SLURM_LOCALID": "0",
+        "SLURM_PROCID": str(rank),
+        "SLURM_NTASKS": str(world),
+        "SLURM_JOB_NODELIST": "127.0.0.1",
+        "IMAGENT_COORDINATOR_PORT": str(port),
+        "IMAGENT_HOST_ADDR": "127.0.0.1",
+        # Bound the wedged-main-thread hard-exit so the flap drill's
+        # blocked flapper dies in seconds, not the 30s default.
+        "IMAGENT_DEADMAN_ESCALATE_SECS": "12",
+    })
+    os.environ.setdefault(
+        "IMAGENT_SAMPLE_TRACE", os.path.join(scratch, "trace"))
+    if phase == "kill":
+        if rank == 2:
+            # Dies abruptly: no tombstone, no cleanup.
+            os.environ["IMAGENT_FAULTS"] = "host.die:after=3"
+        else:
+            # Hold the survivors out of the next collective while the
+            # deadline (2s) expires — the salvage state is then exactly
+            # the 3 pairwise-retired steps. Generous vs the ~2.5s
+            # detection so a loaded sandbox can't wake them early.
+            os.environ["IMAGENT_FAULTS"] = "stall-step:after=3;secs=6"
+    elif phase == "flap":
+        if rank == 0:
+            # Silent past the 2s deadline, then beating again: the
+            # late-returning-host race (freeze from ~4s to ~12s).
+            os.environ["IMAGENT_FAULTS"] = "hb.flap:after=16;secs=8"
+        else:
+            # Park the survivors at a common pre-dispatch frontier
+            # (step 0) while the freeze crosses the deadline, so both
+            # raise the CONTINUE verdict at the same steps_done.
+            os.environ["IMAGENT_FAULTS"] = "stall-step:after=0;secs=10"
+
+    argv = [
+        "--backend", "cpu", "--arch", "resnet18", "--image-size", "16",
+        "--num-classes", "4", "--dataset", "synthetic",
+        "--synthetic-size", "96", "--batch-size", "1",
+        "--elastic", "--global-batch", "12",
+        "--elastic-settle-secs", "4",
+        "--workers", "0", "--no-bf16", "--log-every", "0",
+        "--seed", "0", "--save-model", "--eval-every", "5",
+        "--epochs", epochs, "--lr", "0.05",
+        "--peer-deadline-secs", "2.0", "--heartbeat-secs", "0.25",
+        "--watchdog-secs", "120",
+        "--log-dir", os.path.join(scratch, "tb"),
+        "--ckpt-dir", os.path.join(scratch, "ck"),
+    ]
+    from imagent_tpu.__main__ import main as cli_main
+    return cli_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -207,6 +207,83 @@ def test_requeue_budget_bounds_the_restarts(tmp_path):
     assert "restart budget (2) exhausted" in proc.stderr
 
 
+# A trainer stub that ALSO advances the resume meta: each attempt pops
+# an epoch value and writes it as <ckpt>/last_meta.json — the progress
+# signal the wrapper's budget reset reads.
+_PROGRESS_TRAINER_STUB = """#!/bin/bash
+echo "$@" >> "${CALLS_FILE}"
+code=$(head -n 1 "${CODES_FILE}")
+sed -i 1d "${CODES_FILE}"
+ep=$(head -n 1 "${EPOCHS_FILE}")
+if [ -n "${ep}" ]; then
+  sed -i 1d "${EPOCHS_FILE}"
+  mkdir -p "${TRAIN_CKPT_DIR}"
+  printf '{"epoch": %s, "resume_step": 0}' "${ep}" \
+    > "${TRAIN_CKPT_DIR}/last_meta.json"
+fi
+exit "${code:-0}"
+"""
+
+
+def _run_requeue_progress(tmp_path, codes, epochs, budget=1):
+    calls = tmp_path / "calls.txt"
+    codes_file = tmp_path / "codes.txt"
+    epochs_file = tmp_path / "epochs.txt"
+    ckpt = tmp_path / "ckpt"
+    calls.write_text("")
+    codes_file.write_text("\n".join(str(c) for c in codes) + "\n")
+    epochs_file.write_text("\n".join(str(e) for e in epochs) + "\n")
+    trainer = tmp_path / "trainer.sh"
+    _write_stub(str(trainer), _PROGRESS_TRAINER_STUB)
+    env = dict(os.environ)
+    env.update({"CALLS_FILE": str(calls), "CODES_FILE": str(codes_file),
+                "EPOCHS_FILE": str(epochs_file),
+                "TRAIN_CKPT_DIR": str(ckpt),
+                "IMAGENT_RESTART_BUDGET": str(budget),
+                "IMAGENT_RESTART_BACKOFF": "0"})
+    proc = subprocess.run(
+        ["bash", _REQUEUE, "bash", str(trainer),
+         f"--ckpt-dir={ckpt}"],
+        env=env, capture_output=True, text=True, timeout=60)
+    attempts = [ln for ln in calls.read_text().splitlines() if ln]
+    return proc, attempts
+
+
+def test_requeue_budget_resets_on_clean_progress(tmp_path):
+    """The budget is per incident STREAK (mirroring the engine's
+    rollback give-up semantics): an attempt that completed a NEW epoch
+    — visible in the resume meta — resets the consumed budget, so with
+    budget=1 a run that keeps making progress survives a failure per
+    epoch indefinitely."""
+    proc, attempts = _run_requeue_progress(
+        tmp_path, codes=[87, 87, 87, 0], epochs=[0, 1, 2, 3], budget=1)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert len(attempts) == 4
+    assert "restart budget reset" in proc.stderr, proc.stderr
+
+
+def test_requeue_budget_still_bounds_no_progress_streak(tmp_path):
+    """Without progress (the meta's epoch never advances) the same
+    budget exhausts exactly as before."""
+    proc, attempts = _run_requeue_progress(
+        tmp_path, codes=[87, 87, 87, 87], epochs=[0, 0, 0, 0],
+        budget=1)
+    assert proc.returncode == 87
+    # First attempt wrote epoch 0 (progress from nothing), the restart
+    # wrote epoch 0 again (no progress) -> the 1-restart budget is
+    # spent: first run + 1 restart = 2 attempts.
+    assert len(attempts) == 2
+    assert "restart budget (1) exhausted" in proc.stderr, proc.stderr
+
+
+def test_requeue_ckpt_dir_from_argv(tmp_path):
+    """The wrapper reads --ckpt-dir from the wrapped command itself
+    (both `=` and space-separated spellings; the env override wins)."""
+    with open(_REQUEUE) as f:
+        src = f.read()
+    assert "--ckpt-dir=*" in src and "IMAGENT_CKPT_DIR" in src
+
+
 def test_requeue_retryable_set_matches_exitcode_registry():
     """The wrapper pins the retryable set as a shell literal (it must
     work when Python cannot start); this test is the sync contract
