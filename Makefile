@@ -5,7 +5,7 @@ PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
 
 .PHONY: smoke test lint bench-smoke bench-anatomy bench-input \
-	drill-pod drill-divergence drill-elastic trace-smoke
+	drill-pod drill-divergence drill-elastic drill-sharded trace-smoke
 
 # Static-analysis gate (docs/STATIC_ANALYSIS.md): jaxlint — the
 # JAX/TPU-aware rules in imagent_tpu/analysis — over the package, the
@@ -68,6 +68,20 @@ drill-divergence:
 # elastic-flag validation. All tier-1.
 drill-elastic:
 	$(PYTEST) -m "not slow" tests/test_elastic.py
+
+# Sharded-state resilience suite (docs/OPERATIONS.md "Sharded
+# checkpoints and salvage coverage" — ROADMAP item 2's done bar): the
+# collective-free sharded snapshot format units (coverage rule,
+# jax-free + zero-collectives subprocess asserts, shard-fault fallback
+# chain, Orbax deadman-gate audit) and the REAL-process drills — a
+# 2-process ZeRO-1 pod preempted mid-epoch resuming onto world 2 AND
+# world 1 with loss parity, a 2-process FSDP pod losing a rank to the
+# honest incomplete-coverage verdict, and a TP pod overlapping a
+# slowed sharded commit with cross-process psums then salvaging at
+# full coverage. All tier-1.
+drill-sharded:
+	$(PYTEST) -m "not slow" tests/test_ckpt_sharded.py \
+	    tests/test_zz_sharded_drills.py
 
 # Pod tracer suite (docs/OPERATIONS.md "Reading a pod trace"): the
 # span recorder / torn-tail reader / skew-corrected merge unit tests,

@@ -152,6 +152,15 @@ class Config:
     # the bench-smoke baseline the telemetry regression compares
     # against.
     async_ckpt: bool = True
+    # Checkpoint format family. "snapshot" (default): DP/replicated
+    # states use the flat snapshot format and host-sharded states
+    # (multi-host FSDP/TP/ZeRO-1) the SHARDED snapshot format — both
+    # collective-free on the commit path, both restorable onto any
+    # topology. "orbax" is the legacy escape hatch: sharded states go
+    # through the collective Orbax gather/save (no emergency salvage,
+    # no cross-topology sharded resume) — keep only for reading back
+    # with external Orbax tooling.
+    ckpt_format: str = "snapshot"
 
     # ---- model-health observability (telemetry/health.py) ----
     # In-graph health stats: the train step appends global grad-norm,
@@ -456,6 +465,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fully synchronous checkpoint saves (the "
                         "step loop stalls for serialize+commit+"
                         "manifest)")
+    p.add_argument("--ckpt-format", type=str, default=c.ckpt_format,
+                   choices=["snapshot", "orbax"],
+                   help="checkpoint format family: snapshot = "
+                        "collective-free flat/sharded snapshot formats "
+                        "(emergency salvage + any-topology resume); "
+                        "orbax = legacy collective Orbax for sharded "
+                        "states (escape hatch)")
     # Model-health observability.
     p.add_argument("--no-health-stats", dest="health_stats",
                    action="store_false", default=True,

@@ -52,7 +52,7 @@ from imagent_tpu.telemetry import trace as trace_lib
 from imagent_tpu.telemetry.health import HealthMonitor
 from imagent_tpu.train import (
     TrainState, create_train_state, make_eval_step, make_optimizer,
-    make_train_step, place_state, state_partition_specs,
+    make_train_step, place_state, snapshotable, state_partition_specs,
 )
 from imagent_tpu.utils.logging import TrainLogger
 from imagent_tpu.utils.metrics import AverageMeter
@@ -723,17 +723,26 @@ def run(cfg: Config, stop_check=None) -> dict:
                 "contract). Set --global-batch to the fixed "
                 "optimization batch; grad accumulation absorbs the "
                 "lost/regained hosts.")
-        if (cfg.fsdp or cfg.zero1 or cfg.tensor_parallel
-                or cfg.seq_parallel != "none"
+        if (cfg.tensor_parallel or cfg.seq_parallel != "none"
                 or cfg.pipeline_parallel > 1 or cfg.expert_parallel
                 or cfg.model_parallel > 1):
             raise ValueError(
-                "--elastic supports the plain data-parallel path: "
-                "sharded state (fsdp/tp/sp/pp/ep/zero1) cannot be "
-                "salvaged or re-sharded without the dead peer "
-                "(ROADMAP item 2 is the sharded-state e2e work)")
+                "--elastic supports the data-parallel family (plain "
+                "DP, --fsdp, --zero1 — sharded snapshots reshard onto "
+                "the resized mesh at restore); model-axis meshes "
+                "(tp/sp/pp/ep) change the mesh SHAPE itself on a host "
+                "loss and cannot resize over the data-parallel path")
         if cfg.elastic_settle_secs <= 0:
             raise ValueError("--elastic-settle-secs must be > 0")
+    if cfg.ckpt_format not in ("snapshot", "orbax"):
+        raise ValueError("--ckpt-format must be one of snapshot|orbax, "
+                         f"got {cfg.ckpt_format!r}")
+    if cfg.elastic and cfg.ckpt_format == "orbax":
+        raise ValueError(
+            "--elastic requires --ckpt-format snapshot: the legacy "
+            "Orbax path cannot land a collective-free emergency "
+            "salvage or reshard a sharded checkpoint onto the "
+            "resized mesh")
     # cfg.backend selects the PJRT platform: "tpu" = runtime auto-select;
     # "cpu"/"gpu" are forced, overriding any environment preset.
     # --elastic: membership comes from the filesystem rendezvous (the
@@ -974,29 +983,44 @@ def _pod_death_exit(cfg: Config, err, pod, telem, epoch: int,
     # would turn every rank-0 death into a lost mid-epoch frontier.
     # The flat emergency format is pure local file I/O, so any single
     # host can commit it (checkpoint.save_emergency(any_rank=True)).
+    # SHARDED states (multi-host FSDP/TP/ZeRO-1): every survivor dumps
+    # its own addressable windows — still pure local file I/O — and
+    # the lander assembles them under the coverage rule (commit iff
+    # the survivors' union tiles every leaf; honest incomplete-coverage
+    # fallback otherwise). Shard files are keyed by the ACTIVE mesh
+    # process id (the member's position in the sorted roster), not the
+    # launched rank, because that is what decides which windows a host
+    # holds.
     members = (list(pod.members) if pod is not None
                else list(range(jax.process_count())))
     my_rank = pod.rank if pod is not None else jax.process_index()
     dead = {int(v["peer"])} if v.get("peer") is not None else set()
     survivors = [r for r in members if r not in dead]
     i_land = bool(survivors) and my_rank == min(survivors)
-    if salvage is not None and i_land:
+    sharded = salvage is not None and not snapshotable(salvage["state"])
+    if salvage is not None and (i_land or sharded):
         health_meta = (telem.health.meta_snapshot()
                        if telem.health is not None else {})
         meta = {**best_meta, **topo_meta, **health_meta,
                 "epoch": int(salvage["epoch"]),
                 "resume_step": int(salvage["resume_step"]),
                 "emergency": 1}
+        sorted_members = sorted(int(r) for r in members)
         try:
-            if ckpt_lib.save_emergency(cfg.ckpt_dir, ckpt_lib.LAST,
-                                       salvage["state"], meta,
-                                       keep_last_k=cfg.keep_last_k,
-                                       any_rank=True):
+            landed = ckpt_lib.save_emergency(
+                cfg.ckpt_dir, ckpt_lib.LAST, salvage["state"], meta,
+                keep_last_k=cfg.keep_last_k, any_rank=True,
+                lander=i_land,
+                rank=sorted_members.index(int(my_rank)),
+                survivors=[sorted_members.index(int(r))
+                           for r in survivors])
+            if landed:
                 print("DEADMAN: emergency snapshot committed as LAST "
                       f"(epoch {meta['epoch'] + 1}, "
                       f"resume_step {meta['resume_step']}, landed by "
-                      f"host {my_rank}); --resume restores it",
-                      flush=True)
+                      f"host {my_rank}"
+                      + (", sharded format" if sharded else "")
+                      + "); --resume restores it", flush=True)
         except Exception as se:
             print(f"WARNING: emergency snapshot failed "
                   f"({type(se).__name__}: {se}); the last committed "
@@ -1480,6 +1504,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
     start_epoch, best_top1, best_top5, best_epoch = 0, 0.0, 0.0, -1
     resume_step = 0
     resized_info: dict | None = None
+    restored_info: dict | None = None
     if cfg.resume or cfg.elastic:
         # Fallback-chain restore: a torn/corrupt LAST (kill mid-commit,
         # bit-rot) falls back to the previous LAST, then BEST, instead
@@ -1493,6 +1518,18 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         if restored is not None:
             state, meta, src = restored
             state = place_state(state, mesh, state_specs)
+            # What was restored, for the status/telemetry surfaces: an
+            # emergency salvage or a sharded-format generation must be
+            # visibly not a clean Orbax LAST (satellite of the
+            # sharded-resilience work; describe_checkpoint renders the
+            # same facts jax-free from the meta sidecar).
+            restored_info = {
+                "candidate": src,
+                "format": str(meta.get("ckpt_format", "orbax")),
+                "emergency": int(meta.get("emergency", 0)),
+                "shard_ranks": int(meta.get("shard_ranks", 0) or 0),
+                "coverage": meta.get("shard_coverage"),
+            }
             if (cfg.global_batch
                     and int(meta.get("global_batch", 0))
                     and int(meta.get("global_batch", 0))
@@ -1546,6 +1583,8 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                       + (" [EMERGENCY salvage snapshot]"
                          if int(meta.get("emergency", 0)) else ""),
                       flush=True)
+                from imagent_tpu.status import describe_restored
+                print(describe_restored(restored_info), flush=True)
                 if resized_info is not None:
                     adj = (f"grad_accum {resized_info['grad_accum_prev']}"
                            f" -> {resized_info['grad_accum']}"
@@ -1651,6 +1690,12 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         "steps_per_epoch": train_loader.steps_per_epoch,
         "start_epoch": start_epoch, "resume_step": resume_step,
         "seed": cfg.seed,
+        "ckpt_format": cfg.ckpt_format,
+        # Format/coverage of the restored generation (None on a fresh
+        # start): `telemetry summarize` and post-mortems must see
+        # whether this attempt resumed a clean LAST, a fallback rung,
+        # or an emergency salvage — and in which on-disk format.
+        "restored": restored_info,
     })
     if resized_info is not None:
         # The resize verdict of THIS attempt (restore found a
@@ -1724,6 +1769,10 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                 # silently-shrunk pod must be one glance away.
                 "world_size": jax.process_count(),
                 "launched_world_size": launched_world,
+                # What this attempt restored (format/coverage/salvage):
+                # an incomplete-pod salvage resume stays one glance
+                # away for the whole run, not just its first print.
+                "restored": restored_info,
                 "health": (monitor.snapshot()
                            if monitor is not None else None),
             })
@@ -1747,10 +1796,24 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         if landed["ok"]:
             ckpt_fail_streak = 0
             telem.overlap("ckpt_commit_async", landed["secs"])
+            if landed.get("bytes"):
+                # Per-commit shard geometry (process 0 carries it; the
+                # broadcast verdict on other ranks doesn't): the
+                # telemetry series that shows a sharded commit's
+                # per-rank contribution shrinking/growing across
+                # elastic resizes.
+                telem.gauge("ckpt_commit_bytes",
+                            float(landed["bytes"]))
+                telem.gauge("ckpt_commit_shards",
+                            float(landed.get("shards", 1)))
             if is_master:
+                shard_note = ""
+                if landed.get("shards", 0) > 1:
+                    shard_note = (f", {landed['shards']} shards / "
+                                  f"{landed.get('bytes', 0)} bytes")
                 print(f"async checkpoint '{landed['name']}' committed "
                       f"in {landed['secs']:.2f}s (overlapped with "
-                      "training)", flush=True)
+                      f"training{shard_note})", flush=True)
         else:
             ckpt_commit_failures += 1
             ckpt_fail_streak += 1
@@ -1980,7 +2043,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                         "best_top1": best_top1, "best_top5": best_top5,
                         "best_epoch": best_epoch, **topo_meta,
                         **_health_meta()},
-                    keep_last_k=cfg.keep_last_k)
+                    keep_last_k=cfg.keep_last_k, fmt=cfg.ckpt_format)
                 telem.phase("checkpoint", time.perf_counter() - t_ck)
                 # Classify the agreed stop POD-WIDE (the master's
                 # verdict, broadcast — it alone polls the join files):
@@ -2041,7 +2104,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                             "epoch": epoch, "best_top1": best_top1,
                             "best_top5": best_top5,
                             "best_epoch": best_epoch, **topo_meta,
-                            **_health_meta()})
+                            **_health_meta()}, fmt=cfg.ckpt_format)
             if cfg.save_model:
                 last_meta = {"epoch": epoch, "best_top1": best_top1,
                              "best_top5": best_top5,
@@ -2058,7 +2121,8 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                     _absorb_commit(_storage_guard(
                         ckpt_lib.save_async,
                         cfg.ckpt_dir, ckpt_lib.LAST, state, last_meta,
-                        keep_last_k=cfg.keep_last_k))
+                        keep_last_k=cfg.keep_last_k,
+                        fmt=cfg.ckpt_format))
                 else:
                     # --no-async-ckpt: the fully synchronous baseline
                     # (bench-smoke's reference point) — the loop stalls
@@ -2066,7 +2130,8 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                     _storage_guard(
                         ckpt_lib.save, cfg.ckpt_dir, ckpt_lib.LAST,
                         state, last_meta, block=True,
-                        keep_last_k=cfg.keep_last_k)
+                        keep_last_k=cfg.keep_last_k,
+                        fmt=cfg.ckpt_format)
             # The blocking slice only: the host snapshot for the async
             # LAST (its commit overlaps the next epoch by design) plus
             # any BEST save — the wall time checkpointing actually
@@ -2175,6 +2240,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
             "degraded": bool(pod is not None and pod.degraded),
             "world_size": jax.process_count(),
             "launched_world_size": launched_world,
+            "restored": restored_info,
             "health": (monitor.snapshot()
                        if monitor is not None else None),
         })

@@ -87,6 +87,15 @@ def raise_if_degraded() -> None:
         pod.raise_if_degraded()
 
 
+def degraded() -> bool:
+    """Plain flag read of the active monitor (False when none armed).
+    The checkpoint committer threads poll this while waiting on peer
+    shard files so a dead peer aborts the wait early — a flag read,
+    never a collective, safe on any thread of a degraded pod."""
+    pod = _ACTIVE
+    return bool(pod is not None and getattr(pod, "degraded", False))
+
+
 class DeadmanMonitor:
     """Watch peer heartbeats; trip ``degraded``; escalate if unheeded.
 

@@ -44,6 +44,19 @@ Registered fault points (grep for ``faultinject.fire``):
   any rename — the live generation survives untouched and the async
   path pod-agrees the failed verdict at the next landing point instead
   of hanging or splitting the pod.
+* ``ckpt.shard_corrupt`` (checkpoint, LAST commits only, sharded
+  format): damages ONE rank's ``snapshot.<rank>.bin`` of the
+  just-committed sharded checkpoint — ``mode=truncate`` (default)
+  halves it; ``mode=flip`` inverts one byte, which the stat-only
+  per-host probe cannot see (only the full SHA manifest verification
+  catches it); ``rank`` picks the victim (default 0). Drives the
+  per-shard integrity manifest through the fallback restore chain: a
+  one-host torn shard must pod-agree down to ``last.1``, never mix
+  generations.
+* ``ckpt.shard_missing`` (checkpoint, LAST commits only, sharded
+  format): deletes ONE rank's shard bin post-commit (``rank``,
+  default 0) — the lost-file storage failure the manifest's
+  missing-file check catches before restore trusts the directory.
 * ``step.grad_spike`` (engine): scales one dispatch's learning rate by
   ``factor`` (default 64) — the update ratio spikes on the spiked step
   and the blown-up params spike the following steps' loss/grad norms,

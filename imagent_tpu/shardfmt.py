@@ -1,0 +1,408 @@
+"""Sharded flat-snapshot checkpoint format (version 2) — **jax-free**.
+
+The PR 5 flat snapshot format (``checkpoint._write_snapshot``) assumes
+one host can reach every leaf's full value — true for DP/replicated
+states, false the moment a leaf is genuinely sharded across hosts
+(multi-host FSDP/TP/ZeRO-1).  This module is the sharded generalization:
+
+* every host writes its OWN ``snapshot.<rank>.bin`` (the raw bytes of
+  the index windows it holds) plus a ``shards.<rank>.json`` index —
+  keypath → (global shape, dtype, per-window ``[start, stop)`` index
+  ranges with byte offsets).  The index file is rename-committed AFTER
+  the bin is fsynced, so its *presence* is the completeness marker a
+  peer can trust without any collective;
+* the assembling rank (the lowest live one) unions the per-rank
+  indexes, **coverage-checks** them (deduplicated window volumes must
+  tile every leaf's full index space exactly) and writes the
+  ``snapshot.json`` manifest naming the participating ranks — the
+  atomic description of exactly which bytes reconstruct which leaves;
+* ``restore_arrays`` reassembles full host-numpy arrays from the index
+  windows, with no reference to the topology that wrote them — the
+  caller re-places them onto ANY mesh (resharding at load, the same
+  contract as the flat format's elastic resume).
+
+Coverage rule (the emergency-salvage verdict rides on it): JAX
+shardings tile each array into a disjoint grid, with replicas
+repeating *identical* windows — so after deduplicating exact-duplicate
+windows, the summed window volume equals the array's element count iff
+the shards on hand reconstruct the leaf.  A survivor set whose union
+tiles every leaf (ZeRO-1 params, TP layouts with the model axis inside
+a host, any replica-group layout) can salvage mid-epoch state after a
+peer death; a set missing windows only the corpse held (pure
+cross-host FSDP) reports honest incomplete coverage instead of
+fabricating a checkpoint.
+
+This module is deliberately **jax-free** (asserted by
+``tests/test_ckpt_sharded.py``, the same import-audit pattern as
+``elastic.py``): everything the committer thread and the emergency
+salvage path execute lives here or in plain file ops, so the
+collective-free contract is enforced by construction, not by review.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+# Shared atomic-JSON-write discipline (pid+tid tmp, optional fsync,
+# os.replace) — telemetry.events is jax-free along its whole import
+# chain (the status-CLI assert), so reusing it keeps this module's
+# own jax-free subprocess assert intact.
+from imagent_tpu.telemetry.events import write_json_atomic
+
+FORMAT = "sharded"
+FORMAT_VERSION = 2
+MANIFEST_JSON = "snapshot.json"  # shared filename with the flat format;
+# the "format"/"version" fields inside distinguish the two.
+
+
+def shard_bin(rank: int) -> str:
+    return f"snapshot.{int(rank)}.bin"
+
+
+def shard_index(rank: int) -> str:
+    return f"shards.{int(rank)}.json"
+
+
+def dtype_from_name(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 & friends register here, not in np
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def generation_of(meta: dict) -> dict:
+    """The (epoch, resume_step) pair that identifies one save
+    generation — shard files carry it so an assembler can never mix
+    dumps from different frontiers into one checkpoint."""
+    return {"epoch": int(meta.get("epoch", -1)),
+            "resume_step": int(meta.get("resume_step", 0))}
+
+
+def _atomic_replace(tmp: str, final: str) -> None:
+    os.replace(tmp, final)
+
+
+def _tmp_name(path: str) -> str:
+    # pid + a monotonic tag: two writer threads in one process (a
+    # wedged previous committer racing a fresh one) must not share a
+    # temp file.
+    import threading
+    return f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+
+
+def write_shard(path: str, rank: int, entries: list, generation: dict,
+                ) -> dict:
+    """Write THIS rank's shard dump: ``snapshot.<rank>.bin`` (window
+    bytes, fsynced) then ``shards.<rank>.json`` (rename-committed — its
+    presence tells the assembler the bin is complete).  ``entries`` is
+    the ``train.host_shard_snapshot`` output: one record per tree leaf
+    (EVERY leaf, windows possibly empty when this host holds no shard
+    of it) with ``windows`` as ``(start, stop, ndarray)`` triples.
+    Pure local file I/O — safe on a committer thread and on a degraded
+    pod. Returns the index payload."""
+    os.makedirs(path, exist_ok=True)
+    bin_path = os.path.join(path, shard_bin(rank))
+    leaves, off = [], 0
+    tmp_bin = _tmp_name(bin_path)
+    with open(tmp_bin, "wb") as f:
+        for e in entries:
+            wins = []
+            for start, stop, arr in e["windows"]:
+                data = np.ascontiguousarray(arr).tobytes()
+                wins.append({"start": [int(x) for x in start],
+                             "stop": [int(x) for x in stop],
+                             "offset": off, "nbytes": len(data)})
+                f.write(data)
+                off += len(data)
+            leaves.append({"key": e["key"], "dtype": str(e["dtype"]),
+                           "shape": [int(x) for x in e["shape"]],
+                           "windows": wins})
+        f.flush()
+        os.fsync(f.fileno())
+    _atomic_replace(tmp_bin, bin_path)
+    payload = {"version": FORMAT_VERSION, "rank": int(rank),
+               "generation": dict(generation), "leaves": leaves,
+               "bytes": int(off)}
+    write_json_atomic(os.path.join(path, shard_index(rank)), payload,
+                      fsync=True)
+    return payload
+
+
+def read_shard_index(path: str, rank: int) -> dict | None:
+    try:
+        with open(os.path.join(path, shard_index(rank))) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def collect_shards(path: str, ranks, generation: dict,
+                   ) -> tuple[dict[int, dict], list[int]]:
+    """One scan: ``{rank: index}`` for every rank whose committed index
+    matches ``generation``, plus the ranks still missing (absent, torn,
+    or dumped at a DIFFERENT generation — a mixed-generation dump must
+    read as missing, never as coverage)."""
+    got: dict[int, dict] = {}
+    missing: list[int] = []
+    for r in ranks:
+        idx = read_shard_index(path, int(r))
+        if idx is not None and idx.get("generation") == dict(generation):
+            got[int(r)] = idx
+        else:
+            missing.append(int(r))
+    return got, missing
+
+
+def wait_for_shards(path: str, ranks, generation: dict, timeout: float,
+                    poll: float = 0.05, should_abort=None,
+                    ) -> dict[int, dict]:
+    """Block until every rank in ``ranks`` has rename-committed a
+    generation-matching index file (the collective-free peer-completion
+    barrier: shared-filesystem polling, exactly like the heartbeat
+    mesh).  ``should_abort`` (e.g. the deadman's degraded flag) bails
+    early instead of waiting out a dead peer's timeout."""
+    deadline = time.monotonic() + max(float(timeout), 0.0)
+    got: dict[int, dict] = {}
+    missing = [int(r) for r in ranks]
+    while True:
+        # Incremental: an accepted rank is never re-read — on an
+        # M-host pod over shared storage, re-parsing every index at
+        # every poll would be M opens 20x/s against the very
+        # filesystem the remaining dumps are landing on.
+        fresh, missing = collect_shards(path, missing, generation)
+        got.update(fresh)
+        if not missing:
+            return got
+        if should_abort is not None and should_abort():
+            raise RuntimeError(
+                f"aborted waiting for shard dumps from rank(s) "
+                f"{missing} (pod degraded)")
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"shard dumps from rank(s) {missing} did not appear "
+                f"within {timeout:g}s")
+        time.sleep(poll)
+
+
+def _volume(start, stop) -> int:
+    v = 1
+    for a, b in zip(start, stop):
+        v *= max(int(b) - int(a), 0)
+    return v
+
+
+def _merge_leaves(indexes: dict[int, dict]) -> tuple[dict, list]:
+    """``{key: {shape, dtype, windows(set)}}`` unioned over the ranks,
+    preserving the lowest rank's leaf ORDER (the tree order restore
+    reports errors in). Raises ValueError when ranks disagree on a
+    leaf's global shape/dtype (mixed-architecture dumps)."""
+    per_key: dict[str, dict] = {}
+    order: list[str] = []
+    for rank in sorted(indexes):
+        for leaf in indexes[rank]["leaves"]:
+            k = leaf["key"]
+            rec = per_key.get(k)
+            if rec is None:
+                rec = {"shape": tuple(int(x) for x in leaf["shape"]),
+                       "dtype": str(leaf["dtype"]), "windows": set()}
+                per_key[k] = rec
+                order.append(k)
+            elif (rec["shape"] != tuple(int(x) for x in leaf["shape"])
+                    or rec["dtype"] != str(leaf["dtype"])):
+                raise ValueError(
+                    f"shard dumps disagree on leaf {k}: "
+                    f"{rec['shape']}/{rec['dtype']} vs "
+                    f"{leaf['shape']}/{leaf['dtype']}")
+            for w in leaf["windows"]:
+                rec["windows"].add((tuple(int(x) for x in w["start"]),
+                                    tuple(int(x) for x in w["stop"])))
+    return per_key, order
+
+
+def _incomplete_leaves(per_key: dict) -> list[dict]:
+    """Leaves whose deduped window volumes do not tile the full
+    element count (the shared core of ``coverage`` and
+    ``assemble_manifest`` — one merge, one volume pass)."""
+    incomplete = []
+    for k, rec in per_key.items():
+        total = 1
+        for d in rec["shape"]:
+            total *= int(d)
+        covered = sum(_volume(s, e) for s, e in rec["windows"])
+        if covered != total:
+            incomplete.append({"key": k, "covered": int(covered),
+                               "total": int(total)})
+    return incomplete
+
+
+def coverage(indexes: dict[int, dict]) -> tuple[bool, dict]:
+    """Do the shard dumps on hand reconstruct every leaf?
+
+    Exact-duplicate windows (replicas) dedup; the summed deduped
+    volume must equal the full element count per leaf (JAX shardings
+    tile disjointly, so equality ⟺ coverage; a sum ≠ total — under OR
+    over — fails).  Returns ``(full, report)`` with the report naming
+    the first incomplete leaves and totals — the honest verdict the
+    emergency salvage path prints."""
+    try:
+        per_key, _ = _merge_leaves(indexes)
+    except ValueError as e:
+        return False, {"error": str(e), "leaves": 0, "incomplete": []}
+    incomplete = _incomplete_leaves(per_key)
+    report = {"leaves": len(per_key), "incomplete": incomplete}
+    return not incomplete, report
+
+
+def coverage_text(report: dict) -> str:
+    """One human line for a coverage report (the honest-incomplete
+    WARNING and the drill asserts)."""
+    if report.get("error"):
+        return report["error"]
+    inc = report.get("incomplete", [])
+    if not inc:
+        return f"full coverage over {report.get('leaves', 0)} leaves"
+    head = ", ".join(f"{m['key']} {m['covered']}/{m['total']}"
+                     for m in inc[:3])
+    more = f" (+{len(inc) - 3} more)" if len(inc) > 3 else ""
+    return (f"{len(inc)}/{report.get('leaves', 0)} leaves incomplete: "
+            f"{head}{more}")
+
+
+def assemble_manifest(path: str, indexes: dict[int, dict], meta: dict,
+                      ) -> dict:
+    """Coverage-check the collected shard indexes and write the
+    ``snapshot.json`` manifest (fsynced) describing the committed
+    sharded checkpoint.  Raises ValueError on any coverage gap — an
+    incomplete set must fail the commit, never land as a checkpoint
+    that restores garbage."""
+    per_key, order = _merge_leaves(indexes)  # one merge, reused below
+    incomplete = _incomplete_leaves(per_key)
+    if incomplete:
+        raise ValueError(
+            "sharded snapshot coverage incomplete: " + coverage_text(
+                {"leaves": len(per_key), "incomplete": incomplete}))
+    # The commit's generation KEY, recorded verbatim: the normal
+    # commit paths stamp it with a save-attempt counter beyond the
+    # bare (epoch, resume_step), and the restore-side guard must
+    # compare index keys against what was actually committed.
+    gens = [idx.get("generation") for idx in indexes.values()]
+    if any(g != gens[0] for g in gens[1:]):
+        raise ValueError(f"shard indexes mix generation keys: {gens}")
+    manifest = {
+        "version": FORMAT_VERSION, "format": FORMAT,
+        "generation": dict(gens[0]) if gens and gens[0] else None,
+        "meta": dict(meta),
+        "ranks": sorted(int(r) for r in indexes),
+        "leaves": [{"key": k, "dtype": per_key[k]["dtype"],
+                    "shape": list(per_key[k]["shape"])}
+                   for k in order],
+        "shards": {str(r): {
+            "windows": sum(len(leaf["windows"])
+                           for leaf in indexes[r]["leaves"]),
+            "bytes": int(indexes[r].get("bytes", 0))}
+            for r in sorted(indexes)},
+        "total_bytes": sum(int(indexes[r].get("bytes", 0))
+                           for r in indexes),
+    }
+    write_json_atomic(os.path.join(path, MANIFEST_JSON), manifest,
+                      fsync=True)
+    return manifest
+
+
+def prune_strays(path: str, manifest: dict) -> None:
+    """Drop files in a sharded staging dir that the manifest does not
+    name (a previous failed generation's leftovers, abandoned temp
+    files) — the committed dir must contain exactly what the integrity
+    manifest is about to hash."""
+    keep = {MANIFEST_JSON}
+    for r in manifest.get("ranks", ()):
+        keep.add(shard_bin(r))
+        keep.add(shard_index(r))
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return
+    for entry in entries:
+        if entry not in keep:
+            try:
+                os.remove(os.path.join(path, entry))
+            except OSError:
+                pass
+
+
+def read_manifest(path: str) -> dict | None:
+    """The sharded manifest of a committed checkpoint dir, or None when
+    the dir holds a different format (flat v1) or no manifest."""
+    try:
+        with open(os.path.join(path, MANIFEST_JSON)) as f:
+            spec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if spec.get("format") != FORMAT:
+        return None
+    return spec
+
+
+def restore_arrays(path: str, manifest: dict) -> dict[str, np.ndarray]:
+    """Reassemble ``{keypath: full host-numpy array}`` from the
+    manifest's shard files — topology-free: the caller lays the arrays
+    onto whatever mesh THIS run uses.  Truncated/missing shard files
+    raise ValueError, feeding the resilient fallback walk."""
+    out: dict[str, np.ndarray] = {}
+    for leaf in manifest["leaves"]:
+        out[leaf["key"]] = np.empty(
+            tuple(int(x) for x in leaf["shape"]),
+            dtype_from_name(leaf["dtype"]))
+    want_gen = (manifest.get("generation")
+                or generation_of(manifest.get("meta", {})))
+    for rank in manifest["ranks"]:
+        idx = read_shard_index(path, rank)
+        if idx is None:
+            raise ValueError(
+                f"sharded checkpoint at {path} is missing the shard "
+                f"index of rank {rank} named by its manifest")
+        # Generation guard: a shard file that survived some writer
+        # race (or external damage) with a DIFFERENT (epoch,
+        # resume_step) than the committed manifest must raise — and
+        # pod-agree the fallback walk to the previous generation —
+        # never silently reassemble mixed-generation weights.
+        if idx.get("generation") != want_gen:
+            raise ValueError(
+                f"shard index of rank {rank} at {path} is from "
+                f"generation {idx.get('generation')} but the manifest "
+                f"committed {want_gen} — refusing to mix generations")
+        bin_path = os.path.join(path, shard_bin(rank))
+        try:
+            f = open(bin_path, "rb")
+        except OSError as e:
+            raise ValueError(
+                f"sharded checkpoint at {path} is missing shard file "
+                f"{shard_bin(rank)}: {e}") from e
+        with f:
+            for leaf in idx["leaves"]:
+                arr = out.get(leaf["key"])
+                if arr is None:
+                    raise ValueError(
+                        f"shard index of rank {rank} names leaf "
+                        f"{leaf['key']} absent from the manifest")
+                dtype = dtype_from_name(leaf["dtype"])
+                for w in leaf["windows"]:
+                    f.seek(int(w["offset"]))
+                    buf = f.read(int(w["nbytes"]))
+                    if len(buf) != int(w["nbytes"]):
+                        raise ValueError(
+                            f"shard window of {leaf['key']} in "
+                            f"{shard_bin(rank)} is truncated "
+                            f"({len(buf)}/{w['nbytes']} bytes)")
+                    start = [int(x) for x in w["start"]]
+                    stop = [int(x) for x in w["stop"]]
+                    shape = tuple(b - a for a, b in zip(start, stop))
+                    win = np.frombuffer(buf, dtype).reshape(shape)
+                    sl = tuple(slice(a, b) for a, b in zip(start, stop))
+                    arr[sl] = win
+    return out

@@ -131,6 +131,23 @@ def _scan_hosts(run_dir: str, now: float) -> list[str]:
     return lines
 
 
+def describe_restored(restored: dict) -> str:
+    """One line for a run's restored-generation record (the engine's
+    ``restored_info``: candidate, format, shard geometry, emergency
+    flag) — shared by the status renderer, ``telemetry summarize``,
+    and the engine's resume print so the three surfaces cannot
+    drift."""
+    line = (f"resumed: '{restored.get('candidate', '?')}' "
+            f"({restored.get('format', '?')} format")
+    if restored.get("format") == "sharded":
+        line += (f", {restored.get('shard_ranks', '?')} shard(s), "
+                 f"{restored.get('coverage', '?')} coverage")
+    line += ")"
+    if restored.get("emergency"):
+        line += "  ** EMERGENCY SALVAGE — not a clean LAST **"
+    return line
+
+
 def describe_checkpoint(ckpt_dir: str) -> str | None:
     """One line describing the resume point in ``ckpt_dir`` — and
     crucially WHAT KIND it is: an emergency-salvage snapshot (landed by
@@ -146,14 +163,28 @@ def describe_checkpoint(ckpt_dir: str) -> str | None:
     step = int(meta.get("resume_step", 0) or 0)
     pods = int(meta.get("process_count", 0) or 0)
     by = f" (written by a {pods}-host pod)" if pods else ""
+    # Checkpoint format + shard coverage (sharded-resilience work):
+    # a sharded snapshot — and especially a salvage — must name its
+    # format and coverage instead of masquerading as a plain LAST.
+    # Older sidecars carry no ckpt_format and render unchanged.
+    fmt = str(meta.get("ckpt_format", "") or "")
+    if fmt == "sharded":
+        ranks = int(meta.get("shard_ranks", 0) or 0)
+        cov = str(meta.get("shard_coverage", "") or "?")
+        fmt_note = (f" [sharded snapshot, {ranks} shard(s), "
+                    f"{cov} coverage]")
+    elif fmt:
+        fmt_note = f" [{fmt} format]"
+    else:
+        fmt_note = ""
     if int(meta.get("emergency", 0) or 0):
         return (f"checkpoint 'last': EMERGENCY salvage — resumes "
                 f"epoch {epoch + 2} step {step}{by}; landed by the "
-                "degraded-pod exit, --resume restores it")
+                f"degraded-pod exit, --resume restores it{fmt_note}")
     if step > 0:
         return (f"checkpoint 'last': mid-epoch frontier — resumes "
-                f"epoch {epoch + 2} step {step}{by}")
-    return f"checkpoint 'last': epoch {epoch + 1} complete{by}"
+                f"epoch {epoch + 2} step {step}{by}{fmt_note}")
+    return f"checkpoint 'last': epoch {epoch + 1} complete{by}{fmt_note}"
 
 
 def _last_epoch_record(run_dir: str) -> tuple[dict | None, dict | None,
@@ -235,6 +266,12 @@ def render(run_dir: str, now: float | None = None,
                 f"pod: ** ELASTIC RESIZED — running on {world} of "
                 f"{launched} launched host(s) ** (grad-accum absorbs "
                 "the difference under the --global-batch contract)")
+        restored = st.get("restored")
+        if restored:
+            # What THIS attempt resumed from: format, shard coverage,
+            # and whether it was an emergency salvage — the
+            # incomplete-pod story must be on the one-screen view.
+            lines.append(describe_restored(restored))
         skew = st.get("clock_skew_s")
         if skew is not None:
             # Measured at the epoch-boundary sync point (the telemetry

@@ -112,6 +112,14 @@ def summarize(run_dir: str, ckpt_dir: str | None = None) -> str:
             f"{run_start.get('global_batch', '?')} x"
             f"{run_start.get('process_count', '?')} host(s), "
             f"{run_start.get('steps_per_epoch', '?')} steps/epoch")
+        restored = run_start.get("restored")
+        if isinstance(restored, dict):
+            # The sharded-resilience surfacing: which generation this
+            # attempt resumed, in which checkpoint format, with what
+            # shard coverage — an emergency salvage must be visibly
+            # not a clean LAST in the offline table too.
+            from imagent_tpu.status import describe_restored
+            lines.append("  " + describe_restored(restored))
     lines.append("  ".join(c.rjust(w)
                            for c, w in zip(columns, widths)))
     for epoch in sorted(by_epoch):

@@ -43,6 +43,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import threading
 import time
 
 SCHEMA_VERSION = 1
@@ -80,7 +81,13 @@ def write_json_atomic(path: str, payload: dict,
     this one, never a torn file. ``fsync`` for records that must
     survive the imminent process death (the flight recorder)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = f"{path}.{os.getpid()}.tmp"
+    # pid + thread id: two THREADS of one process writing the same
+    # path concurrently (the elastic rendezvous's roster.json repair,
+    # where every waiter may race to heal the publisher's crash
+    # window; the test harness's threads-as-ranks) must not share a
+    # temp file — one replace would steal the other's, and the loser's
+    # rename raises FileNotFoundError.
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(jsonsafe(payload), f)
         if fsync:

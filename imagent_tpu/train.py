@@ -857,6 +857,65 @@ def host_snapshot(state: TrainState) -> TrainState:
     return jax.tree.map(fetch, state)
 
 
+def host_shard_snapshot(state: TrainState,
+                        skip_replicated: bool = False) -> list[dict]:
+    """Per-host shard dump for the SHARDED snapshot format
+    (``imagent_tpu/shardfmt.py``) — the sharded generalization of
+    ``host_snapshot``: every leaf of the tree appears once, carrying
+    THIS host's addressable shards as ``(start, stop, numpy)`` index
+    windows against the leaf's GLOBAL shape (exact-duplicate windows
+    from local replicas deduplicated; a leaf this host holds no shard
+    of contributes an empty window list, so every dump still
+    enumerates the full keypath/shape table the coverage check needs).
+
+    ``skip_replicated`` is the POD-level dedup for the normal commit
+    paths: every rank but the lead passes it so fully-pod-replicated
+    leaves (host scalars, and e.g. the ENTIRE parameter tree under
+    ZeRO-1) ride only the lead's dump — an M-host pod must not write
+    M full copies of a multi-GB replicated tree into every commit.
+    ``save_emergency`` never skips: there the designated writer may be
+    the corpse, so every survivor's dump must be able to cover.
+
+    This is the blocking slice of a sharded async checkpoint: pure
+    device→host copies of shards this host ALREADY holds — no
+    collectives, no constraint on what the rest of the pod is doing,
+    callable from a degraded pod with dead peers."""
+    entries = []
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        key = jax.tree_util.keystr(keypath)
+        if not isinstance(leaf, jax.Array):
+            arr = np.asarray(leaf)
+            entries.append({
+                "key": key, "dtype": np.dtype(arr.dtype).name,
+                "shape": list(arr.shape),
+                "windows": ([] if skip_replicated else
+                            [((0,) * arr.ndim, tuple(arr.shape), arr)])})
+            continue
+        gshape = tuple(int(d) for d in leaf.shape)
+        sharding = getattr(leaf, "sharding", None)
+        if (skip_replicated and sharding is not None
+                and sharding.is_fully_replicated):
+            entries.append({"key": key,
+                            "dtype": np.dtype(leaf.dtype).name,
+                            "shape": list(gshape), "windows": []})
+            continue
+        seen: set = set()
+        windows = []
+        for shard in leaf.addressable_shards:
+            idx = shard.index  # tuple of slices into the global array
+            start = tuple(int(s.start or 0) for s in idx)
+            stop = tuple(int(s.stop) if s.stop is not None
+                         else gshape[d] for d, s in enumerate(idx))
+            if (start, stop) in seen:
+                continue  # local replica: identical window, once only
+            seen.add((start, stop))
+            windows.append((start, stop, np.asarray(shard.data)))
+        entries.append({"key": key,
+                        "dtype": np.dtype(leaf.dtype).name,
+                        "shape": list(gshape), "windows": windows})
+    return entries
+
+
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
     """Place the state replicated over the mesh — the DDP initial
     parameter broadcast (``imagenet.py:316``) done by sharding layout.

@@ -1,0 +1,782 @@
+"""Sharded-state resilience: the collective-free sharded snapshot
+format (``imagent_tpu/shardfmt.py`` + ``checkpoint.py``'s sharded
+save/commit/restore/salvage paths) — format and unit layers:
+
+* format/unit tests — window roundtrips, the coverage rule,
+  generation matching, the collective FENCE (both directions), the
+  ``ckpt.shard_corrupt`` / ``ckpt.shard_missing`` fault chain through
+  the fallback restore walk, the emergency coverage verdicts, and the
+  deadman-gate audit on the remaining legacy-Orbax save/restore
+  entries;
+* subprocess asserts — ``shardfmt`` stays jax-free (the
+  ``elastic.py`` import-audit pattern), and a full sharded
+  save_async→commit→land cycle completes with every
+  ``multihost_utils`` collective POISONED (the zero-collectives
+  proof).
+
+The REAL-OS-process acceptance drills live in
+``test_zz_sharded_drills.py`` (collected last on purpose — see its
+docstring); ``make drill-sharded`` runs both files.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mp_launch import clean_env
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+
+
+# ---------------------------------------------------------------------------
+# Format / unit layer
+# ---------------------------------------------------------------------------
+
+
+def test_shardfmt_is_jax_free():
+    """The sharded format module must never import jax: everything the
+    committer threads and the degraded-pod salvage execute lives there,
+    so the collective-free contract holds by construction (the
+    ``elastic.py`` audit pattern, exercised end-to-end: the subprocess
+    also runs a real write/assemble/restore cycle first)."""
+    src = os.path.join(_REPO, "imagent_tpu", "shardfmt.py")
+    with open(src) as f:
+        assert "import jax" not in f.read()
+    code = (
+        "import sys, numpy as np, tempfile, os\n"
+        "from imagent_tpu import shardfmt\n"
+        "d = tempfile.mkdtemp()\n"
+        "gen = {'epoch': 0, 'resume_step': 0}\n"
+        "a = np.arange(12, dtype=np.float32).reshape(3, 4)\n"
+        "e0 = [{'key': '.p', 'dtype': 'float32', 'shape': [3, 4],\n"
+        "       'windows': [((0, 0), (2, 4), a[:2])]}]\n"
+        "e1 = [{'key': '.p', 'dtype': 'float32', 'shape': [3, 4],\n"
+        "       'windows': [((2, 0), (3, 4), a[2:])]}]\n"
+        "shardfmt.write_shard(d, 0, e0, gen)\n"
+        "shardfmt.write_shard(d, 1, e1, gen)\n"
+        "got, missing = shardfmt.collect_shards(d, [0, 1], gen)\n"
+        "assert not missing\n"
+        "man = shardfmt.assemble_manifest(d, got, {'epoch': 0})\n"
+        "out = shardfmt.restore_arrays(d, man)\n"
+        "assert np.array_equal(out['.p'], a)\n"
+        "bad = [m for m in sys.modules if m == 'jax'"
+        " or m.startswith('jax.')]\n"
+        "sys.exit(1 if bad else 0)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=_REPO,
+                          env=clean_env(), capture_output=True,
+                          text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_shard_roundtrip_scalars_and_bf16(tmp_path):
+    """0-d leaves, bf16 windows, and empty window lists all round-trip
+    through the per-rank files and the manifest."""
+    import ml_dtypes
+
+    from imagent_tpu import shardfmt
+
+    d = str(tmp_path)
+    gen = {"epoch": 2, "resume_step": 7}
+    step = np.asarray(42, np.int32)
+    w = np.arange(8, dtype=ml_dtypes.bfloat16).reshape(2, 4)
+    e0 = [
+        {"key": ".step", "dtype": "int32", "shape": [],
+         "windows": [((), (), step)]},
+        {"key": ".w", "dtype": "bfloat16", "shape": [2, 4],
+         "windows": [((0, 0), (1, 4), w[:1])]},
+    ]
+    e1 = [
+        {"key": ".step", "dtype": "int32", "shape": [],
+         "windows": []},  # rank 1 holds no shard of .step
+        {"key": ".w", "dtype": "bfloat16", "shape": [2, 4],
+         "windows": [((1, 0), (2, 4), w[1:])]},
+    ]
+    shardfmt.write_shard(d, 0, e0, gen)
+    shardfmt.write_shard(d, 1, e1, gen)
+    got, missing = shardfmt.collect_shards(d, [0, 1], gen)
+    assert not missing
+    full, report = shardfmt.coverage(got)
+    assert full, shardfmt.coverage_text(report)
+    man = shardfmt.assemble_manifest(d, got,
+                                     {"epoch": 2, "resume_step": 7})
+    out = shardfmt.restore_arrays(d, man)
+    assert out[".step"].shape == () and int(out[".step"]) == 42
+    assert out[".w"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out[".w"], np.float32), np.asarray(w, np.float32))
+
+
+def test_coverage_rules(tmp_path):
+    """Replicated windows dedup to one; a missing window is honest
+    incomplete; a generation-mismatched dump reads as MISSING (never
+    as coverage); disagreeing global shapes fail loudly."""
+    from imagent_tpu import shardfmt
+
+    d = str(tmp_path)
+    gen = {"epoch": 0, "resume_step": 3}
+    a = np.ones((4, 2), np.float32)
+    full_win = [((0, 0), (4, 2), a)]
+    half = [((0, 0), (2, 2), a[:2])]
+    # Two ranks holding the identical full window (replication).
+    shardfmt.write_shard(d, 0, [{"key": ".p", "dtype": "float32",
+                                 "shape": [4, 2],
+                                 "windows": full_win}], gen)
+    shardfmt.write_shard(d, 1, [{"key": ".p", "dtype": "float32",
+                                 "shape": [4, 2],
+                                 "windows": full_win}], gen)
+    got, _ = shardfmt.collect_shards(d, [0, 1], gen)
+    full, report = shardfmt.coverage(got)
+    assert full and report["leaves"] == 1
+    # Half coverage is incomplete, with the gap named.
+    full, report = shardfmt.coverage(
+        {0: {"leaves": [{"key": ".p", "dtype": "float32",
+                         "shape": [4, 2],
+                         "windows": [{"start": [0, 0], "stop": [2, 2],
+                                      "offset": 0, "nbytes": 16}]}]}})
+    assert not full
+    assert "4/8" in shardfmt.coverage_text(report).replace(" ", "")[
+        len(".p"):] or report["incomplete"][0]["covered"] == 4
+    # A dump from another generation is MISSING, not coverage.
+    shutil.rmtree(d)
+    os.makedirs(d)
+    shardfmt.write_shard(d, 0, [{"key": ".p", "dtype": "float32",
+                                 "shape": [4, 2],
+                                 "windows": half}],
+                         {"epoch": 0, "resume_step": 3})
+    shardfmt.write_shard(d, 1, [{"key": ".p", "dtype": "float32",
+                                 "shape": [4, 2],
+                                 "windows": [((2, 0), (4, 2), a[2:])]}],
+                         {"epoch": 0, "resume_step": 4})  # older step
+    got, missing = shardfmt.collect_shards(
+        d, [0, 1], {"epoch": 0, "resume_step": 3})
+    assert missing == [1]
+    full, _ = shardfmt.coverage(got)
+    assert not full
+    # Shape disagreement across dumps fails the coverage check.
+    bad = {
+        0: {"leaves": [{"key": ".p", "dtype": "float32",
+                        "shape": [4, 2], "windows": []}]},
+        1: {"leaves": [{"key": ".p", "dtype": "float32",
+                        "shape": [8, 2], "windows": []}]},
+    }
+    full, report = shardfmt.coverage(bad)
+    assert not full and "disagree" in report["error"]
+
+
+def _fsdp_sharded_state():
+    """An 8-fake-device FSDP-sharded TrainState + its host twin — the
+    in-process stand-in for a multi-host sharded state (fully
+    addressable here, so production code paths that branch on
+    ``snapshotable`` are monkeypatched where needed)."""
+    import jax
+
+    from imagent_tpu.cluster import make_mesh
+    from imagent_tpu.models.vit import VisionTransformer
+    from imagent_tpu.parallel.fsdp import fsdp_state_specs
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, place_state,
+    )
+
+    mesh = make_mesh()
+    model = VisionTransformer(patch_size=8, hidden_dim=32, num_layers=1,
+                              num_heads=2, mlp_dim=32, num_classes=4)
+    opt = make_optimizer(name="adamw")
+    host = jax.device_get(
+        create_train_state(model, jax.random.key(0), 16, opt))
+    specs = fsdp_state_specs(host, 8)
+    state = place_state(host, mesh, specs)
+    target = create_train_state(model, jax.random.key(1), 16, opt)
+    return host, state, target
+
+
+def _commit_sharded_generation(ckpt_dir, meta, entries_by_rank,
+                               keep_last_k=1):
+    """File-level commit of one sharded generation (what the committer
+    thread does), used to build multi-generation fallback scenarios
+    without OS processes."""
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu import shardfmt
+
+    staging = os.path.join(ckpt_dir, "last" + ckpt_lib._STAGING)
+    gen = shardfmt.generation_of(meta)
+    for rank, entries in entries_by_rank.items():
+        shardfmt.write_shard(staging, rank, entries, gen)
+    got, missing = shardfmt.collect_shards(
+        staging, sorted(entries_by_rank), gen)
+    assert not missing
+    manifest = shardfmt.assemble_manifest(
+        staging, got, ckpt_lib._numeric_meta(meta))
+    with ckpt_lib._collectives_fenced():
+        ckpt_lib._commit_files(
+            ckpt_dir, "last",
+            dict(meta, ckpt_format="sharded",
+                 shard_ranks=len(manifest["ranks"]),
+                 shard_coverage="full"),
+            keep_last_k=keep_last_k, manifest_in_thread=True)
+
+
+def _split_two_ranks(entries):
+    """Split a host_shard_snapshot dump into two fake rank dumps
+    (alternating windows) — both needed for full coverage."""
+    r0, r1 = [], []
+    for e in entries:
+        r0.append({**e, "windows": e["windows"][0::2]})
+        r1.append({**e, "windows": e["windows"][1::2]})
+    return {0: r0, 1: r1}
+
+
+def test_sharded_commit_restore_roundtrip(tmp_path):
+    """Two-fake-rank sharded commit restores bit-exactly through the
+    PUBLIC restore path, reports its format/shard meta, and passes the
+    resilient walk + the jax-free CLI surfacing."""
+    import jax
+
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu.train import host_shard_snapshot
+
+    host, state, target = _fsdp_sharded_state()
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    meta = {"epoch": 3, "resume_step": 5, "best_top1": 12.5,
+            "global_batch": 16, "process_count": 2, "seed": 0}
+    _commit_sharded_generation(
+        ck, meta, _split_two_ranks(host_shard_snapshot(state)))
+    st2, meta2 = ckpt_lib.restore(ck, "last", target)
+    assert meta2["ckpt_format"] == "sharded"
+    assert meta2["shard_ranks"] == 2
+    assert meta2["shard_coverage"] == "full"
+    assert meta2["epoch"] == 3 and meta2["resume_step"] == 5
+    for a, b in zip(jax.tree_util.tree_leaves(host),
+                    jax.tree_util.tree_leaves(st2)):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+    restored = ckpt_lib.restore_resilient(ck, target)
+    assert restored is not None and restored[2] == "last"
+    # The jax-free surfaces name the format + coverage.
+    from imagent_tpu.status import describe_checkpoint
+    line = describe_checkpoint(ck)
+    assert "sharded snapshot" in line and "2 shard(s)" in line, line
+    assert "full coverage" in line, line
+
+
+@pytest.mark.parametrize("fault", [
+    "ckpt.shard_corrupt:rank=1",
+    "ckpt.shard_corrupt:rank=1;mode=flip",
+    "ckpt.shard_missing:rank=0",
+])
+def test_shard_fault_falls_back_to_previous_generation(tmp_path, fault):
+    """A ONE-rank shard torn/flipped/deleted post-commit must walk the
+    restore chain down to ``last.1`` — the previous intact generation —
+    never mix the two (the per-shard integrity manifest catches even
+    the size-preserving bit-flip the stat probe cannot see)."""
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu.resilience import faultinject
+    from imagent_tpu.train import host_shard_snapshot
+
+    host, state, target = _fsdp_sharded_state()
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    by_rank = _split_two_ranks(host_shard_snapshot(state))
+    _commit_sharded_generation(ck, {"epoch": 0}, by_rank)
+    try:
+        faultinject.configure(fault)
+        _commit_sharded_generation(ck, {"epoch": 1}, by_rank)
+    finally:
+        faultinject.reset()
+    restored = ckpt_lib.restore_resilient(ck, target)
+    assert restored is not None
+    _st, meta, cand = restored
+    assert cand == "last.1", cand
+    assert int(meta["epoch"]) == 0, meta
+    assert meta["ckpt_format"] == "sharded"
+
+
+def test_collective_fence_both_directions():
+    from imagent_tpu import checkpoint as ckpt_lib
+
+    assert ckpt_lib._multihost() is not None  # open outside the fence
+    with ckpt_lib._collectives_fenced():
+        with pytest.raises(RuntimeError, match="collective-free"):
+            ckpt_lib._multihost()
+    assert ckpt_lib._multihost() is not None  # fence released
+
+
+def test_sharded_commit_path_zero_collectives_subprocess(tmp_path):
+    """The zero-collectives assert for the whole sharded
+    save_async→commit→land cycle: every ``multihost_utils`` entry point
+    is POISONED, ``snapshotable`` is forced False so the sharded branch
+    runs for real (snapshot, committer thread, wait, coverage,
+    manifest, swap, verdict landing) — any collective anywhere fails
+    the subprocess."""
+    code = (
+        "import os, sys\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +"
+        " ' --xla_force_host_platform_device_count=8')\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from jax.experimental import multihost_utils as mh\n"
+        "def _boom(*a, **k):\n"
+        "    raise AssertionError('collective on the sharded commit "
+        "path')\n"
+        "for name in ('broadcast_one_to_all', 'sync_global_devices',\n"
+        "             'process_allgather', 'assert_equal'):\n"
+        "    setattr(mh, name, _boom)\n"
+        "from imagent_tpu import checkpoint as ckpt_lib\n"
+        "ckpt_lib.snapshotable = lambda s: False  # force sharded\n"
+        "from imagent_tpu.cluster import make_mesh\n"
+        "from imagent_tpu.models.vit import VisionTransformer\n"
+        "from imagent_tpu.parallel.fsdp import fsdp_state_specs\n"
+        "from imagent_tpu.train import (create_train_state,\n"
+        "    make_optimizer, place_state)\n"
+        "mesh = make_mesh()\n"
+        "model = VisionTransformer(patch_size=8, hidden_dim=32,\n"
+        "    num_layers=1, num_heads=2, mlp_dim=32, num_classes=4)\n"
+        "opt = make_optimizer(name='adamw')\n"
+        "host = jax.device_get(create_train_state(model,\n"
+        "    jax.random.key(0), 16, opt))\n"
+        "state = place_state(host, mesh, fsdp_state_specs(host, 8))\n"
+        f"ck = {str(tmp_path / 'ck')!r}\n"
+        "landed = ckpt_lib.save_async(ck, 'last', state,\n"
+        "    {'epoch': 0, 'resume_step': 0}, keep_last_k=1)\n"
+        "assert landed is None\n"
+        "landed = ckpt_lib.poll_async(block=True)\n"
+        "assert landed is not None and landed['ok'], landed\n"
+        "assert landed['shards'] == 1, landed\n"
+        "import json\n"
+        "with open(os.path.join(ck, 'last', 'snapshot.json')) as f:\n"
+        "    assert json.load(f)['format'] == 'sharded'\n"
+        "print('ZERO_COLLECTIVES_OK')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=_REPO,
+                          env=clean_env(), capture_output=True,
+                          text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ZERO_COLLECTIVES_OK" in proc.stdout
+
+
+def test_emergency_sharded_coverage_verdicts(tmp_path, monkeypatch):
+    """The salvage coverage rule, single-process: full coverage from
+    the on-hand dumps commits an emergency sharded LAST; a survivor
+    set that cannot cover (or a non-lander contributor) returns False
+    with the previous generation untouched and no torn staging."""
+    import jax
+
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu.train import host_shard_snapshot
+
+    host, state, target = _fsdp_sharded_state()
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    # A committed generation to stand on.
+    _commit_sharded_generation(
+        ck, {"epoch": 0}, _split_two_ranks(host_shard_snapshot(state)))
+    monkeypatch.setattr(ckpt_lib, "snapshotable", lambda s: False)
+    monkeypatch.setenv("IMAGENT_EMERGENCY_SHARD_WAIT_SECS", "0.2")
+    meta = {"epoch": 1, "resume_step": 4, "emergency": 1}
+    # Survivors whose dumps genuinely miss windows (each keeps only
+    # its first window of every sharded leaf — the corpse held the
+    # rest): the pure-cross-host-FSDP shape of the problem.
+    real_entries = host_shard_snapshot(state)
+    partial = [({**e, "windows": e["windows"][:1]}
+                if len(e["windows"]) > 1 else e)
+               for e in real_entries]
+    monkeypatch.setattr(ckpt_lib, "host_shard_snapshot",
+                        lambda s: partial)
+    # Non-lander: contributes its dump, does not commit.
+    assert ckpt_lib.save_emergency(ck, "last", state, meta,
+                                   keep_last_k=1, lander=False,
+                                   rank=1, survivors=[0, 1]) is False
+    # Lander with every survivor's (partial) dump on hand: honest
+    # incomplete -> False, epoch-0 LAST stands, staging gone.
+    assert ckpt_lib.save_emergency(ck, "last", state, meta,
+                                   keep_last_k=1, lander=True,
+                                   rank=0, survivors=[0, 1]) is False
+    assert not os.path.isdir(os.path.join(ck, "last.staging"))
+    _st, m0, cand = ckpt_lib.restore_resilient(ck, target)
+    assert cand == "last" and int(m0["epoch"]) == 0
+    monkeypatch.setattr(ckpt_lib, "host_shard_snapshot",
+                        lambda s: real_entries)
+    # Lander whose own dump covers everything (this state is fully
+    # addressable): commits the salvage with the emergency meta.
+    assert ckpt_lib.save_emergency(ck, "last", state, meta,
+                                   keep_last_k=1, lander=True,
+                                   rank=0, survivors=[0]) is True
+    _st, m1, cand = ckpt_lib.restore_resilient(ck, target)
+    assert cand == "last"
+    assert int(m1["epoch"]) == 1 and int(m1["resume_step"]) == 4
+    assert int(m1["emergency"]) == 1
+    assert m1["ckpt_format"] == "sharded"
+    # The rotation kept the previous generation as the fallback rung.
+    _st, m2 = ckpt_lib.restore(ck, "last.1", target)
+    assert int(m2["epoch"]) == 0
+    for leaf_a, leaf_b in zip(jax.tree_util.tree_leaves(host),
+                              jax.tree_util.tree_leaves(_st)):
+        np.testing.assert_array_equal(
+            np.asarray(leaf_a, np.float32),
+            np.asarray(leaf_b, np.float32))
+
+
+def test_wrong_arch_rejected_from_index_alone(tmp_path):
+    """A wrong-arch/--num-classes snapshot candidate is rejected from
+    its JSON index ALONE — the resilient fallback walk must not pay a
+    full (multi-GB in production) sequential bin read per rejected
+    candidate, for the flat AND the sharded format alike. The bins are
+    deleted here, so any bin read would raise the WRONG error."""
+    import jax
+
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu.models.vit import VisionTransformer
+    from imagent_tpu.train import (
+        create_train_state, host_shard_snapshot, make_optimizer,
+    )
+
+    host, state, _target = _fsdp_sharded_state()
+    wrong_model = VisionTransformer(patch_size=8, hidden_dim=32,
+                                    num_layers=1, num_heads=2,
+                                    mlp_dim=32, num_classes=8)
+    wrong = create_train_state(wrong_model, jax.random.key(2), 16,
+                               make_optimizer(name="adamw"))
+
+    # Sharded: commit, delete every shard bin, restore with a target
+    # whose head differs (same keyset, different shape — the deep
+    # case) -> the shape mismatch fires, never a missing-file error.
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    _commit_sharded_generation(
+        ck, {"epoch": 0}, _split_two_ranks(host_shard_snapshot(state)))
+    for fn in os.listdir(os.path.join(ck, "last")):
+        if fn.endswith(".bin"):
+            os.unlink(os.path.join(ck, "last", fn))
+    with pytest.raises(ValueError, match="expects|does not match"):
+        ckpt_lib.restore(ck, "last", wrong)
+
+    # Flat format: the same property through _restore_snapshot.
+    flat = str(tmp_path / "flat")
+    os.makedirs(flat)
+    ckpt_lib._write_snapshot(flat, host, {"epoch": 0})
+    os.unlink(os.path.join(flat, "snapshot.bin"))
+    with pytest.raises(ValueError, match="expects|does not match"):
+        ckpt_lib._restore_snapshot(flat, wrong)
+
+
+def test_emergency_collect_never_rereads_accepted_ranks(
+        tmp_path, monkeypatch):
+    """The salvage collection window is incremental like
+    ``wait_for_shards``: an accepted rank's index is parsed ONCE and
+    the coverage merge re-runs only when a new dump lands — not 10x/s
+    for the whole window against the very filesystem the remaining
+    multi-GB dumps are landing on."""
+    import threading
+    import time
+
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu import shardfmt
+    from imagent_tpu.train import host_shard_snapshot
+
+    _host, state, _target = _fsdp_sharded_state()
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    monkeypatch.setattr(ckpt_lib, "snapshotable", lambda s: False)
+    monkeypatch.setenv("IMAGENT_EMERGENCY_SHARD_WAIT_SECS", "30")
+    meta = {"epoch": 1, "resume_step": 4, "emergency": 1}
+    by_rank = _split_two_ranks(host_shard_snapshot(state))
+    monkeypatch.setattr(ckpt_lib, "host_shard_snapshot",
+                        lambda s: by_rank[0])
+
+    reads: dict[int, int] = {}
+    real_read = shardfmt.read_shard_index
+
+    def counting_read(path, rank):
+        if ckpt_lib._SALVAGE in path:
+            reads[int(rank)] = reads.get(int(rank), 0) + 1
+        return real_read(path, rank)
+
+    monkeypatch.setattr(shardfmt, "read_shard_index", counting_read)
+
+    salvage = os.path.join(ck, "last" + ckpt_lib._SALVAGE)
+    gen = shardfmt.generation_of(meta)
+
+    def late_rank1():
+        time.sleep(0.6)  # several 0.1s polls with rank 1 outstanding
+        shardfmt.write_shard(salvage, 1, by_rank[1], gen)
+
+    t = threading.Thread(target=late_rank1)
+    t.start()
+    try:
+        assert ckpt_lib.save_emergency(ck, "last", state, meta,
+                                       keep_last_k=1, lander=True,
+                                       rank=0, survivors=[0, 1]) is True
+    finally:
+        t.join()
+    # The lander's own dump is present from the first poll: parsed
+    # exactly once. Rank 1 was re-polled until its dump landed.
+    assert reads.get(0) == 1, reads
+    assert reads.get(1, 0) >= 1, reads
+
+
+def test_emergency_wait_covers_the_normal_shard_budget(monkeypatch):
+    """The salvage collection window must grant a peer its bounded
+    committer join PLUS the same dump time the normal commit path
+    budgets for identical bytes — a healthy survivor set whose
+    multi-GB dumps take as long as an ordinary commit must never be
+    ruled incomplete (and a salvageable frontier discarded)."""
+    from imagent_tpu import checkpoint as ckpt_lib
+
+    monkeypatch.delenv("IMAGENT_EMERGENCY_SHARD_WAIT_SECS",
+                       raising=False)
+    monkeypatch.delenv("IMAGENT_SHARD_WAIT_SECS", raising=False)
+    assert (ckpt_lib._emergency_wait_secs()
+            >= ckpt_lib._COMMITTER_JOIN_SECS
+            + ckpt_lib._SHARD_WAIT_SECS)
+    # Tracks a drill's lowered shard budget...
+    monkeypatch.setenv("IMAGENT_SHARD_WAIT_SECS", "2.0")
+    assert (ckpt_lib._emergency_wait_secs()
+            == ckpt_lib._COMMITTER_JOIN_SECS + 2.0)
+    # ...and the emergency env overrides both.
+    monkeypatch.setenv("IMAGENT_EMERGENCY_SHARD_WAIT_SECS", "0.5")
+    assert ckpt_lib._emergency_wait_secs() == 0.5
+
+
+def test_stale_salvage_dir_swept_at_restore(tmp_path):
+    """A lander killed mid-salvage leaves the multi-writer
+    ``<name>.salvage`` dump dir behind; the requeued pod's restore —
+    the first point where no survivor can still be writing — must
+    sweep it instead of letting checkpoint-sized dead dumps accumulate
+    until shared storage fills."""
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu.train import host_shard_snapshot
+
+    host, state, target = _fsdp_sharded_state()
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    _commit_sharded_generation(
+        ck, {"epoch": 0}, _split_two_ranks(host_shard_snapshot(state)))
+    stale = os.path.join(ck, "last" + ckpt_lib._SALVAGE)
+    os.makedirs(stale)
+    with open(os.path.join(stale, "snapshot.0.bin"), "wb") as f:
+        f.write(b"\x00" * 64)
+    restored = ckpt_lib.restore_resilient(ck, target)
+    assert restored is not None and restored[2] == "last"
+    assert not os.path.isdir(stale)
+
+
+def test_stale_staging_shard_dump_swept_at_restore(tmp_path):
+    """A crashed sharded commit can leave a completed, rename-committed
+    shard index in ``.staging``; if the pod restores, retrains, and
+    re-commits the SAME generation, ``wait_for_shards`` would accept
+    the stale index instantly and commit bytes from the dead attempt's
+    trajectory. The restore walk — the gate every go-back-in-progress
+    path passes through — must sweep THIS rank's stale dump files
+    (own-files-only: concurrent ranks never race each other; other
+    ranks' leftovers become strays ``prune_strays`` drops)."""
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu import shardfmt
+    from imagent_tpu.train import host_shard_snapshot
+
+    host, state, target = _fsdp_sharded_state()
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    by_rank = _split_two_ranks(host_shard_snapshot(state))
+    _commit_sharded_generation(ck, {"epoch": 0}, by_rank)
+    # The dead attempt: a completed rank-0 dump for the NEXT
+    # generation sits in staging when the pod comes back.
+    staging = os.path.join(ck, "last" + ckpt_lib._STAGING)
+    stale_gen = {"epoch": 1, "resume_step": 0}
+    shardfmt.write_shard(staging, 0, by_rank[0], stale_gen)
+    stale = [os.path.join(staging, shardfmt.shard_index(0)),
+             os.path.join(staging, shardfmt.shard_bin(0))]
+    assert all(os.path.isfile(p) for p in stale)
+    restored = ckpt_lib.restore_resilient(ck, target)
+    assert restored is not None and restored[2] == "last"
+    assert not any(os.path.exists(p) for p in stale)
+    # Own-files-only: rank 1's leftovers are not this rank's to sweep.
+    shardfmt.write_shard(staging, 1, by_rank[1], stale_gen)
+    ckpt_lib._clear_stale_shard_dumps(ck, 0)
+    assert os.path.isfile(os.path.join(staging,
+                                       shardfmt.shard_index(1)))
+    ckpt_lib._clear_stale_shard_dumps(ck, 1)
+    assert not os.path.exists(os.path.join(staging,
+                                           shardfmt.shard_index(1)))
+
+
+def test_host_shard_snapshot_skip_replicated():
+    """Pod-level dedup: with ``skip_replicated`` (every non-lead rank
+    on the normal commit paths) fully-replicated leaves — the ENTIRE
+    param tree under ZeRO-1 — contribute an empty window list (no
+    M-fold write amplification), while genuinely sharded leaves keep
+    their windows; the keypath/shape table stays identical, which is
+    what the coverage check enumerates."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from imagent_tpu.train import host_shard_snapshot
+
+    host, state, target = _fsdp_sharded_state()
+    full = host_shard_snapshot(state)
+    dedup = host_shard_snapshot(state, skip_replicated=True)
+    assert [(e["key"], e["shape"], e["dtype"]) for e in full] == \
+        [(e["key"], e["shape"], e["dtype"]) for e in dedup]
+    n_kept = sum(1 for e in dedup if e["windows"])
+    n_emptied = sum(1 for e, d in zip(full, dedup)
+                    if e["windows"] and not d["windows"])
+    assert n_kept > 0      # sharded leaves still ride every dump
+    assert n_emptied > 0   # replicated leaves ride the lead's only
+    # A fully-replicated placement dedups to zero windows everywhere.
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("d",))
+    repl = jax.device_put(host, NamedSharding(mesh, P()))
+    assert all(not e["windows"]
+               for e in host_shard_snapshot(repl, skip_replicated=True)
+               if e["key"].startswith(".params"))
+
+
+def test_sharded_save_seq_rejects_resurrected_stale_dump(tmp_path):
+    """Same-boot stale-dump protection: two sharded saves of the SAME
+    (epoch, resume_step) mint distinct seq-stamped generation keys
+    (pod-synchronous calls keep the counter in lockstep with zero
+    wire traffic), so an index a slow writer resurrects from a failed
+    earlier attempt reads as MISSING for the retried commit — the
+    peer wait is never satisfied by the dead attempt's bytes. (The
+    cross-boot case — writer dead — is the restore-time sweep.)"""
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu import shardfmt
+
+    g1 = ckpt_lib._next_sharded_gen({"epoch": 2, "resume_step": 7})
+    g2 = ckpt_lib._next_sharded_gen({"epoch": 2, "resume_step": 7})
+    assert (g1["epoch"], g1["resume_step"]) == (2, 7)
+    assert g1 != g2 and g2["seq"] > g1["seq"]
+    d = str(tmp_path / "st")
+    a = np.arange(8, dtype=np.float32)
+    entries = [{"key": ".p", "dtype": "float32", "shape": [8],
+                "windows": [((0,), (8,), a)]}]
+    shardfmt.write_shard(d, 1, entries, g1)  # the dead attempt's dump
+    got, missing = shardfmt.collect_shards(d, [1], g2)
+    assert missing == [1] and not got
+    # The emergency salvage key stays bare (epoch, resume_step): every
+    # survivor derives it from the same meta with no agreed counter.
+    bare = shardfmt.generation_of({"epoch": 2, "resume_step": 7})
+    assert "seq" not in bare
+
+
+def test_blocking_sharded_save_skips_on_wedged_writer(
+        tmp_path, monkeypatch, capsys):
+    """The blocking sharded save must mirror save_async's non-zero-rank
+    guard: a previous shard writer still alive after the bounded
+    poll_async join means this rank SKIPS its dump (failing the save
+    on process 0's peer wait) instead of writing fresh files a
+    late-unwedging stale writer could interleave with."""
+    import threading
+
+    from imagent_tpu import checkpoint as ckpt_lib
+
+    host, state, target = _fsdp_sharded_state()
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    release = threading.Event()
+    wedged = threading.Thread(target=release.wait, daemon=True)
+    wedged.start()
+    monkeypatch.setattr(ckpt_lib, "_commit_thread", wedged)
+    monkeypatch.setattr(ckpt_lib.jax, "process_index", lambda: 1)
+    try:
+        ckpt_lib._save_sharded_blocking(ck, "last", state,
+                                        {"epoch": 0}, 0)
+    finally:
+        release.set()
+    staging = os.path.join(ck, "last" + ckpt_lib._STAGING)
+    from imagent_tpu import shardfmt
+    assert not os.path.exists(os.path.join(staging,
+                                           shardfmt.shard_index(1)))
+    assert "wedged" in capsys.readouterr().out
+
+
+def test_wait_for_shards_never_rereads_accepted_ranks(
+        tmp_path, monkeypatch):
+    """The peer-completion wait must poll only the ranks still
+    missing: on an M-host pod over shared storage, re-parsing every
+    accepted index 20x/s for the full wait would compete with the
+    very dumps being waited on."""
+    from imagent_tpu import shardfmt
+
+    d = str(tmp_path / "st")
+    gen = {"epoch": 0, "resume_step": 0}
+    a = np.arange(4, dtype=np.float32)
+    shardfmt.write_shard(d, 0, [{"key": ".p", "dtype": "float32",
+                                 "shape": [4],
+                                 "windows": [((0,), (4,), a)]}], gen)
+    reads = {0: 0, 1: 0}
+    real = shardfmt.read_shard_index
+
+    def counting(path, rank):
+        reads[int(rank)] += 1
+        return real(path, rank)
+
+    monkeypatch.setattr(shardfmt, "read_shard_index", counting)
+    with pytest.raises(TimeoutError):
+        shardfmt.wait_for_shards(d, [0, 1], gen, timeout=0.3,
+                                 poll=0.02)
+    assert reads[0] == 1   # accepted on the first scan, never re-read
+    assert reads[1] > 3    # the missing rank is what keeps polling
+
+
+def test_legacy_orbax_entries_are_deadman_gated(tmp_path):
+    """Satellite audit: the remaining legacy-Orbax save/restore
+    entries consult ``deadman.raise_if_degraded`` BEFORE their
+    collectives — a degraded pod diverts instead of filing into an
+    Orbax gather/restore the dead peer never completes (previously
+    only the snapshot-format path was drilled against a dead peer)."""
+    import jax
+
+    from imagent_tpu import checkpoint as ckpt_lib
+    from imagent_tpu.models import create_model
+    from imagent_tpu.resilience import deadman, exitcodes
+    from imagent_tpu.train import create_train_state, make_optimizer
+
+    model = create_model("resnet18", 4, False)
+    state = create_train_state(model, jax.random.key(0), 16,
+                               make_optimizer())
+    ck = str(tmp_path / "ck")
+    ckpt_lib.save(ck, "last", state, {"epoch": 0}, fmt="orbax")
+
+    class _DegradedPod:
+        degraded = True
+
+        def raise_if_degraded(self, **kw):
+            raise exitcodes.PeerDeathError("drill: pod degraded")
+
+    deadman.activate(_DegradedPod())
+    try:
+        assert deadman.degraded() is True
+        with pytest.raises(exitcodes.PeerDeathError):
+            ckpt_lib.save(ck, "last", state, {"epoch": 1}, fmt="orbax")
+        with pytest.raises(exitcodes.PeerDeathError):
+            ckpt_lib.restore(ck, "last", state)
+    finally:
+        deadman.deactivate()
+    assert deadman.degraded() is False
+    # Undegraded, the same orbax checkpoint still restores.
+    _st, meta = ckpt_lib.restore(ck, "last", state)
+    assert int(meta["epoch"]) == 0 and meta["ckpt_format"] == "orbax"
+
+
+def test_engine_validates_ckpt_format(tmp_path):
+    from imagent_tpu.config import Config
+    from imagent_tpu.engine import run
+
+    base = dict(arch="resnet18", image_size=16, num_classes=4,
+                batch_size=4, epochs=1, dataset="synthetic",
+                synthetic_size=16, workers=0, bf16=False,
+                seed=0, backend="cpu",
+                log_dir=os.path.join(str(tmp_path), "tb"),
+                ckpt_dir=os.path.join(str(tmp_path), "ck"))
+    with pytest.raises(ValueError, match="--ckpt-format"):
+        run(Config(**base, ckpt_format="bogus"))
+    with pytest.raises(ValueError, match="--ckpt-format snapshot"):
+        run(Config(**base, elastic=True, global_batch=16,
+                   ckpt_format="orbax"))

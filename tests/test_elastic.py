@@ -421,13 +421,26 @@ def test_elastic_requires_global_batch(tmp_path):
         run(_engine_cfg(tmp_path, elastic=True))
 
 
-def test_elastic_refuses_sharded_paths(tmp_path):
+def test_elastic_refuses_model_axis_paths(tmp_path):
+    """The sharded-snapshot work made --fsdp/--zero1 legal under
+    --elastic (their shards reshard onto the resized mesh at restore);
+    model-axis meshes still cannot resize — a host loss changes the
+    mesh shape itself."""
     from imagent_tpu.engine import run
-    with pytest.raises(ValueError, match="data-parallel path"):
+    with pytest.raises(ValueError, match="data-parallel family"):
         run(_engine_cfg(tmp_path, elastic=True, global_batch=16,
+                        model_parallel=2, tensor_parallel=True))
+    with pytest.raises(ValueError, match="data-parallel family"):
+        run(_engine_cfg(tmp_path, elastic=True, global_batch=16,
+                        pipeline_parallel=2))
+    # fsdp/zero1 now pass the elastic gate: these configs fail LATER,
+    # at the global-batch divisibility check — proof the elastic
+    # validation no longer rejects them.
+    with pytest.raises(ValueError, match="not divisible"):
+        run(_engine_cfg(tmp_path, elastic=True, global_batch=18,
                         fsdp=True))
-    with pytest.raises(ValueError, match="data-parallel path"):
-        run(_engine_cfg(tmp_path, elastic=True, global_batch=16,
+    with pytest.raises(ValueError, match="not divisible"):
+        run(_engine_cfg(tmp_path, elastic=True, global_batch=18,
                         zero1=True))
 
 
