@@ -5,7 +5,8 @@ PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
 
 .PHONY: smoke test lint bench-smoke bench-anatomy bench-input \
-	drill-pod drill-divergence drill-elastic drill-sharded trace-smoke
+	drill-pod drill-divergence drill-elastic drill-sharded trace-smoke \
+	slo-check slo-smoke
 
 # Static-analysis gate (docs/STATIC_ANALYSIS.md): jaxlint — the
 # JAX/TPU-aware rules in imagent_tpu/analysis — over the package, the
@@ -82,6 +83,24 @@ drill-elastic:
 drill-sharded:
 	$(PYTEST) -m "not slow" tests/test_ckpt_sharded.py \
 	    tests/test_zz_sharded_drills.py
+
+# Evaluate a finished run directory against the default SLO spec
+# (docs/OPERATIONS.md "Monitoring, SLOs, and regression gating"):
+# exit 1 on any breached epoch. Override the run dir with
+# `make slo-check RUN=<log_dir>` and the spec with SLO_SPEC=<path>.
+RUN ?= runs/imagent_tpu
+SLO_SPEC ?= default
+slo-check:
+	$(PY) -m imagent_tpu.telemetry slo $(RUN) --spec $(SLO_SPEC)
+
+# SLO engine / exporter / regression-gate suite (docs/OPERATIONS.md
+# "Monitoring, SLOs, and regression gating"): spec validation + the
+# evaluator edge cases, the golden OpenMetrics exposition + live
+# scrape, the regress verdict/exit-code matrix, and the mid-run
+# recompile sentinel drills. All tier-1; the focused loop for the
+# observability-gating layer.
+slo-smoke:
+	$(PYTEST) -m "not slow" tests/test_slo.py
 
 # Pod tracer suite (docs/OPERATIONS.md "Reading a pod trace"): the
 # span recorder / torn-tail reader / skew-corrected merge unit tests,

@@ -30,41 +30,43 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# The order-statistic median-CI lives in imagent_tpu/utils/stats.py so
+# the cross-run regression gate (telemetry/regress.py) judges deltas
+# with the SAME noise model this driver publishes. The underscore
+# names are kept as aliases (tests + external callers).
+from imagent_tpu.utils.stats import (  # noqa: E402
+    median_ci as _median_ci, spread_pct as _spread_pct,
+)
+
 BASELINE_IMG_S_PER_CHIP = 152.8  # reference img/s/GPU (BASELINE.md)
 NORTH_STAR_IMG_S_PER_CHIP = 1200.0  # BASELINE.json resnet50@224 target
 
 
-def _median_ci(samples) -> tuple[float, float, float]:
-    """Nonparametric (sign-test / binomial order-statistic) confidence
-    interval for the MEDIAN: ``(lo, hi, coverage_pct)``. Chooses the
-    narrowest symmetric order-statistic interval with >= 95% coverage;
-    small n cannot reach 95% (n=5 full range covers 93.75%), in which
-    case the full range is reported with its ACTUAL coverage — the JSON
-    self-explains what the estimator delivers instead of overclaiming
-    (VERDICT r5 weak 1)."""
-    from math import comb
+def environment() -> dict:
+    """Environment fingerprint stamped into every bench record (the
+    ``env`` key): ``telemetry regress`` refuses to compare numbers
+    measured on different hardware/topology/software instead of
+    producing a nonsense verdict (regress.ENV_KEYS)."""
+    import platform
 
-    xs = sorted(float(s) for s in samples)
-    n = len(xs)
-    if n < 2:
-        return xs[0], xs[0], 0.0
-    cdf = [comb(n, i) / 2.0 ** n for i in range(n + 1)]
-    best = None
-    for r in range(n // 2, 0, -1):  # narrowest first: largest r
-        coverage = 1.0 - 2.0 * sum(cdf[:r])
-        if coverage >= 0.95:
-            best = (xs[r - 1], xs[n - r], 100.0 * coverage)
-            break
-    if best is None:  # full range, honest coverage
-        best = (xs[0], xs[-1], 100.0 * (1.0 - 2.0 * cdf[0]))
-    return best
+    import jax
 
-
-def _spread_pct(samples) -> float:
-    med = float(np.median(samples))
-    if med <= 0:  # differencing noise swallowed the signal entirely
-        return float("inf")
-    return 100.0 * (max(samples) - min(samples)) / med
+    try:
+        import jaxlib
+        jaxlib_version = getattr(jaxlib, "__version__", "?")
+    except ImportError:  # pragma: no cover — jax ships jaxlib
+        jaxlib_version = "?"
+    return {
+        "device_kind": jax.devices()[0].device_kind,
+        "device_count": jax.device_count(),
+        "process_count": jax.process_count(),
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib_version,
+        "python": platform.python_version(),
+        # The wire/contract dtype the measured step consumes
+        # (uint8-wire PR 2): a float32-wire rerun is not comparable.
+        "transfer_dtype": "uint8",
+    }
 
 
 def _robust_samples(sample_fn, pairs: int, max_spread_pct: float,
@@ -237,6 +239,10 @@ def main() -> int:
     primary = measure("resnet18", 448, 128)
     primary["vs_baseline"] = round(
         primary["value"] / BASELINE_IMG_S_PER_CHIP, 3)
+    # Environment fingerprint (regress.ENV_KEYS): cross-hardware /
+    # cross-topology BENCH comparisons are refused by `telemetry
+    # regress` on these keys instead of yielding a nonsense verdict.
+    primary["env"] = environment()
     try:
         primary["chip_calibration"] = chip_calibration()
     except Exception as e:  # noqa: BLE001 — never take down the record

@@ -34,6 +34,15 @@ drift means an emission site was dropped or double-fired), and merge
 into a ``trace.json`` that validates against the Chrome trace event
 schema (``telemetry/trace.py``).
 
+Stage 4 — cross-run regression gate: stage 2's two runs double as a
+known-degraded/clean twin pair, so ``telemetry regress``
+(``telemetry/regress.py``) is asserted END TO END: the sync run
+(whose injected slow commit BLOCKED the step loop) must trip a
+nonzero exit against its async twin with ``ckpt_block_s`` among the
+named regressions, and the clean twin compared against itself must
+exit 0 — the gate can both catch a real regression and stay quiet on
+identical runs.
+
 Prints one JSON line per stage and exits non-zero on any crash, a
 non-finite loss, or a telemetry-regression violation.
 """
@@ -160,7 +169,7 @@ def _ckpt_run(root: str, tag: str, async_on: bool) -> list[dict]:
     return [e for e in events if e["event"] == "epoch"]
 
 
-def _ckpt_regression_stage() -> int:
+def _ckpt_regression_stage() -> tuple[int, str]:
     import tempfile
 
     root = tempfile.mkdtemp(prefix="bench_ckpt_")
@@ -208,6 +217,53 @@ def _ckpt_regression_stage() -> int:
         "async_checkpoint_s": round(async_ckpt, 3),
         "async_overlap_s": round(async_overlap, 3),
         "injected_commit_s": _SLOW_COMMIT_SECS,
+    }))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return (1 if failures else 0), root
+
+
+def _regress_gate_stage(root: str) -> int:
+    """Stage 4 — the cross-run regression gate, drilled on stage 2's
+    twins: the sync run paid the injected slow commit ON the critical
+    path (its blocking `checkpoint` phase carries it), the async run
+    hid the same injected latency — a real degradation with a known
+    cause, which `telemetry regress` must catch (exit 1, ckpt_block_s
+    named) while the clean twin vs itself stays quiet (exit 0).
+    --warmup 0: the degradation was deliberately injected on epoch 0's
+    LAST commit, which the default compile-warmup exemption would
+    exclude."""
+    from imagent_tpu.telemetry import regress as regress_lib
+
+    sync_dir = os.path.join(root, "tb_sync")
+    async_dir = os.path.join(root, "tb_async")
+    failures = []
+    rc_degraded = regress_lib.main(
+        [sync_dir, "--baseline", async_dir, "--warmup", "0"])
+    if rc_degraded != 1:
+        failures.append(
+            f"regress exited {rc_degraded} for the slow-commit run vs "
+            "its clean twin — the gate missed a seeded degradation")
+    verdict = regress_lib.compare(
+        regress_lib.load_run(sync_dir, warmup=0),
+        regress_lib.load_run(async_dir, warmup=0))
+    named = [f["metric"] for f in verdict["regressions"]]
+    if "ckpt_block_s" not in named:
+        failures.append(
+            f"regress named {named} but not ckpt_block_s — the "
+            "blocking-commit degradation was misattributed")
+    rc_clean = regress_lib.main(
+        [async_dir, "--baseline", async_dir, "--warmup", "0"])
+    if rc_clean != 0:
+        failures.append(
+            f"regress exited {rc_clean} comparing the clean run "
+            "against itself — the gate fails identical runs")
+    print(json.dumps({
+        "metric": "bench_regress_gate",
+        "status": "FAIL" if failures else "PASS",
+        "degraded_exit": rc_degraded,
+        "clean_exit": rc_clean,
+        "regressions_named": named,
     }))
     for f in failures:
         print(f"FAIL: {f}", file=sys.stderr)
@@ -300,7 +356,10 @@ def main() -> int:
     rc = _input_path_stage()
     if rc:
         return rc
-    rc = _ckpt_regression_stage()
+    rc, ckpt_root = _ckpt_regression_stage()
+    if rc:
+        return rc
+    rc = _regress_gate_stage(ckpt_root)
     if rc:
         return rc
     return _trace_stage()

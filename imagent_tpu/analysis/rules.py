@@ -724,21 +724,59 @@ _TB_WRITE_METHODS = {"add_scalar", "add_scalars", "add_histogram"}
 # starting with a letter — what every telemetry series in the repo
 # uses ("goodput/fraction", "steptime/p95_ms", "data/h2d_mb").
 _TAG_RE = re.compile(r"^[a-z][a-z0-9_]*(/[a-z][a-z0-9_]*)*$")
+# OpenMetrics family names (telemetry/export.py Exposition.family):
+# strict snake_case, no slashes/colons. A call site is judged as a
+# family declaration when its second argument is a literal metric
+# type — the Exposition signature — so unrelated `.family(...)`
+# methods elsewhere are never misjudged.
+_OM_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_OM_TYPES = {"gauge", "counter", "info", "histogram", "summary"}
 
 
 @rule("telemetry-tag-format",
-      "TB tags must be namespace/snake_case literals; interpolating "
-      "values (step numbers) into a tag mints unbounded series")
+      "TB tags and exporter metric families must be snake_case "
+      "literals; interpolating values (step numbers) into a name "
+      "mints unbounded series")
 def check_telemetry_tags(ctx: ModuleContext) -> Iterator[Finding]:
     """Conservative: only literal and f-string first arguments to the
     writer methods are judged (a variable tag is invisible here — the
     call sites that build tags dynamically must keep the family
-    bounded, which is what the suppression justification documents)."""
+    bounded, which is what the suppression justification documents).
+    Exporter family declarations (``.family(name, "gauge", ...)``) get
+    the same treatment with the OpenMetrics name grammar: a scraper's
+    series set must be bounded and greppable, so family names are
+    literal snake_case, never f-string-minted."""
     for node in ast.walk(ctx.tree):
         if not (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
-                and node.func.attr in _TB_WRITE_METHODS
                 and node.args):
+            continue
+        if (node.func.attr == "family" and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and node.args[1].value in _OM_TYPES):
+            name = node.args[0]
+            if isinstance(name, ast.JoinedStr):
+                if any(isinstance(v, ast.FormattedValue)
+                       for v in name.values):
+                    yield ctx.finding(
+                        node, "telemetry-tag-format",
+                        "f-string OpenMetrics family name in "
+                        ".family(): every distinct interpolated value "
+                        "mints a NEW metric family for the scraper — "
+                        "put variables in LABELS (bounded), or "
+                        "suppress with the justification that the "
+                        "family set is bounded")
+            elif isinstance(name, ast.Constant) \
+                    and isinstance(name.value, str) \
+                    and not _OM_NAME_RE.match(name.value):
+                yield ctx.finding(
+                    node, "telemetry-tag-format",
+                    f"OpenMetrics family name {name.value!r} is not "
+                    "snake_case (^[a-z][a-z0-9_]*$): scrapers and "
+                    "recording rules expect the Prometheus naming "
+                    "grammar (no slashes, no capitals)")
+            continue
+        if node.func.attr not in _TB_WRITE_METHODS:
             continue
         tag = node.args[0]
         if isinstance(tag, ast.JoinedStr):

@@ -190,6 +190,23 @@ class Config:
     # referenced from the tombstone. 0 disables.
     flightrec_steps: int = 256
 
+    # ---- SLO engine + OpenMetrics exporter ----
+    # Declarative run-health objectives (telemetry/slo.py), evaluated
+    # against every epoch's telemetry record on the master: "off"
+    # (default), "default" (the built-in production spec), or a JSON
+    # spec file path. Breaches become slo_breach telemetry events, TB
+    # markers, status.json fields and loud prints; `python -m
+    # imagent_tpu.telemetry slo <run_dir>` replays the evaluation
+    # offline (`make slo-check`).
+    slo: str = "off"
+    # Live OpenMetrics/Prometheus endpoint (telemetry/export.py):
+    # process 0 serves GET /metrics on this port with goodput phases,
+    # step percentiles, health EWMAs, HBM, pod/per-peer heartbeat
+    # state, checkpoint commit geometry, SLO breach counters and
+    # compile-event counts — refreshed at epoch boundaries (the same
+    # state status.json records). 0 = off.
+    metrics_port: int = 0
+
     # ---- pod tracer (telemetry/trace.py) ----
     # Cross-host span timeline: every subsystem (engine phases,
     # checkpoint snapshot/commit/restore, staging-queue waits, offload
@@ -504,6 +521,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "step/health records flushed as "
                         "flightrec.<rank>.json on fatal exits "
                         "(0 disables)")
+    # SLO engine + OpenMetrics exporter.
+    p.add_argument("--slo", type=str, default=c.slo, metavar="SPEC",
+                   help="declarative run-health SLOs evaluated at "
+                        "every epoch boundary (telemetry/slo.py): "
+                        "'off', 'default' (built-in spec), or a JSON "
+                        "spec file; breaches become slo_breach "
+                        "events, TB markers, status.json fields and "
+                        "loud prints")
+    p.add_argument("--metrics-port", type=int, default=c.metrics_port,
+                   help="serve live OpenMetrics/Prometheus text on "
+                        "this port from process 0 (GET /metrics; "
+                        "goodput, step percentiles, health, pod, "
+                        "ckpt, SLO and compile series; 0 = off)")
     # Pod tracer.
     p.add_argument("--trace", type=str, default=c.trace,
                    choices=["off", "phases", "steps"],

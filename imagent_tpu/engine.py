@@ -22,6 +22,7 @@ the reference's ``torch.cuda.synchronize()`` every step
 from __future__ import annotations
 
 import collections
+import contextlib
 import os
 import signal
 import time
@@ -47,12 +48,16 @@ from imagent_tpu.resilience.watchdog import StepWatchdog
 from imagent_tpu.schedule import lr_for_epoch
 from imagent_tpu.status import StatusWriter
 from imagent_tpu.telemetry import TelemetrySession, parse_profile_at_step
+from imagent_tpu.telemetry import export as export_lib
 from imagent_tpu.telemetry import flightrec as flightrec_lib
+from imagent_tpu.telemetry import recompile as recompile_lib
+from imagent_tpu.telemetry import slo as slo_lib
 from imagent_tpu.telemetry import trace as trace_lib
 from imagent_tpu.telemetry.health import HealthMonitor
 from imagent_tpu.train import (
     TrainState, create_train_state, make_eval_step, make_optimizer,
-    make_train_step, place_state, snapshotable, state_partition_specs,
+    make_train_step, place_state, shard_batch, snapshotable,
+    state_partition_specs,
 )
 from imagent_tpu.utils.logging import TrainLogger
 from imagent_tpu.utils.metrics import AverageMeter
@@ -364,6 +369,22 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                     print(f"FAULT step.grad_spike: lr x{factor:g} for "
                           "this step", flush=True)
                     lr_step = lr_arr * jnp.float32(factor)
+                f = faultinject.fire("step.shape_change")
+                if f is not None:
+                    # Recompile drill: crop THIS batch spatially so the
+                    # compiled step sees a new input shape mid-run —
+                    # exactly the silent retrace the recompile sentinel
+                    # (telemetry/recompile.py) must catch and name. The
+                    # crop is done on the HOST (a deliberate sync: a
+                    # device-side slice would itself jit-compile and
+                    # the drill must produce exactly ONE new compile)
+                    # and re-placed via the normal shard_batch path
+                    # (pure placement, no compile).
+                    crop = int(f.get("crop", 2))
+                    print(f"FAULT step.shape_change: cropping this "
+                          f"batch by {crop}px (forces a retrace)",
+                          flush=True)
+                    images, labels = shard_batch(mesh, np.asarray(images)[:, crop:, crop:, :], np.asarray(labels))  # jaxlint: disable=blocking-call-in-step-loop -- drill-only fault path; the hard host sync is the drill's point (stage ONE new shape with no extra eager-op compile)
                 f = faultinject.fire("stall-step")
                 if f is not None:  # hung collective / wedged input stand-in
                     time.sleep(float(f.get("secs", 5.0)))
@@ -743,6 +764,19 @@ def run(cfg: Config, stop_check=None) -> dict:
             "Orbax path cannot land a collective-free emergency "
             "salvage or reshard a sharded checkpoint onto the "
             "resized mesh")
+    # SLO / exporter flag contract (telemetry/slo.py + export.py): a
+    # bad spec or port must fail on the launch host, before any
+    # distributed init.
+    if cfg.metrics_port < 0:
+        raise ValueError("--metrics-port must be >= 0 (0 = off)")
+    if cfg.metrics_port and not cfg.telemetry:
+        raise ValueError("--metrics-port serves the telemetry "
+                         "session's epoch-boundary state; drop "
+                         "--no-telemetry")
+    slo_lib.parse_spec_arg(cfg.slo)  # raises ValueError on a bad spec
+    if cfg.slo not in ("", "off") and not cfg.telemetry:
+        raise ValueError("--slo evaluates the telemetry epoch record; "
+                         "drop --no-telemetry")
     # cfg.backend selects the PJRT platform: "tpu" = runtime auto-select;
     # "cpu"/"gpu" are forced, overriding any environment preset.
     # --elastic: membership comes from the filesystem rendezvous (the
@@ -912,6 +946,13 @@ def run(cfg: Config, stop_check=None) -> dict:
         # commit land, the torch export) + deactivate.
         trace_lib.close_active()
         flightrec_lib.deactivate()
+        # The recompile sentinel and the OpenMetrics endpoint live
+        # exactly as long as the run: compiles after this are not this
+        # run's problem, and a closed port (connection refused) is the
+        # scraper's down signal — module-global handles so the fatal
+        # ramps above need no extra plumbing.
+        recompile_lib.deactivate()
+        export_lib.close_active()
         if pod is not None:
             deadman_lib.deactivate()
             pod.stop()
@@ -1665,6 +1706,44 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                       flush=True)
 
         monitor.on_anomaly = _on_anomaly
+    # Runtime recompile sentinel (telemetry/recompile.py): classifies
+    # every XLA backend compile as warmup / expected / midrun. A
+    # midrun compile — the silent TPU throughput killer the goodput
+    # heuristic can only misattribute to step_drain — becomes a
+    # compile_event record, a trace instant, a loud master WARN naming
+    # the jitted function, and the `recompiles` counter the SLO
+    # objective `recompiles_max` judges. The hooks fire only when a
+    # compile actually happens: zero cost on the steady step path.
+    sentinel = None
+    if cfg.telemetry:
+
+        def _on_midrun_compile(ev: dict) -> None:
+            telem.count("recompiles")
+            telem.compile_event(ev)
+            trace_lib.instant("compile_event", cat="compile",
+                              fun=ev.get("fun", "?"),
+                              secs=ev.get("secs", 0.0))
+            if is_master:
+                print(f"WARNING: RECOMPILE mid-run: `{ev.get('fun')}` "
+                      f"recompiled ({ev.get('secs', 0.0):.2f}s) after "
+                      "warmup — a changing input shape/dtype or a "
+                      "traced-value branch is silently stalling the "
+                      "step loop (docs/OPERATIONS.md 'Monitoring, "
+                      "SLOs, and regression gating'; jaxlint "
+                      "recompile-hazard finds the static cases)",
+                      flush=True)
+
+        sentinel = recompile_lib.RecompileSentinel(
+            on_midrun=_on_midrun_compile)
+        recompile_lib.activate(sentinel)
+    # Live SLO evaluation (telemetry/slo.py, --slo): the spec is
+    # judged against each epoch's telemetry record on the master —
+    # the record is already pod-aggregated, so the verdict needs no
+    # collective. Breaches become slo_breach events, TB markers,
+    # status.json fields and loud prints.
+    slo_spec = slo_lib.parse_spec_arg(cfg.slo)
+    slo_session = (slo_lib.SloSession(slo_spec)
+                   if slo_spec is not None and is_master else None)
     if recorder is not None:
         recorder.note(arch=cfg.arch, global_batch=global_batch,
                       process_count=jax.process_count(),
@@ -1680,6 +1759,26 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
     # visible on one screen.
     launched_world = (getattr(senv, "launched_world", 0)
                       if senv is not None else 0) or jax.process_count()
+    # OpenMetrics exporter (--metrics-port, telemetry/export.py):
+    # process 0 serves the epoch-boundary telemetry state as a pull
+    # endpoint for fleet scrapers. Module-global handle so run()'s
+    # finally closes the port on every exit ramp.
+    exporter = None
+    exporter_info = {
+        "arch": cfg.arch,
+        "chip": jax.devices()[0].device_kind,
+        "transfer_dtype": cfg.transfer_dtype,
+        "launched": launched_world,
+    }
+    if cfg.metrics_port and is_master:
+        exporter = export_lib.MetricsExporter(cfg.metrics_port).start()
+        export_lib.activate(exporter)
+        # Identity + liveness are scrapable before the first epoch
+        # boundary lands real series.
+        exporter.update(export_lib.build_state(run_info=exporter_info))
+        print(f"metrics: serving OpenMetrics on "
+              f":{exporter.port}/metrics (refreshed at epoch "
+              "boundaries)", flush=True)
     telem.run_start({
         "arch": cfg.arch, "global_batch": global_batch,
         "process_count": jax.process_count(),
@@ -1691,6 +1790,15 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         "start_epoch": start_epoch, "resume_step": resume_step,
         "seed": cfg.seed,
         "ckpt_format": cfg.ckpt_format,
+        # Environment fingerprint (telemetry/regress.py ENV_KEYS): the
+        # regression gate refuses cross-hardware/config comparisons on
+        # these instead of producing a nonsense verdict. Additions,
+        # not a schema bump (consumers ignore unknown keys).
+        "device_kind": jax.devices()[0].device_kind,
+        "jax_version": jax.__version__,
+        "image_size": cfg.image_size,
+        "batch_size": cfg.batch_size,
+        "transfer_dtype": cfg.transfer_dtype,
         # Format/coverage of the restored generation (None on a fresh
         # start): `telemetry summarize` and post-mortems must see
         # whether this attempt resumed a clean LAST, a fallback rung,
@@ -1706,6 +1814,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
     anomaly_hwm = [0]  # monitor.anomalies already attributed to epochs
     last_input_alert = [None]  # newest epoch's input-wait alert (if any)
     last_clock_skew = [None]   # newest epoch's max pod wall-clock skew
+    last_slo = [None]          # newest SLO session status (if armed)
 
     def _end_telemetry_epoch(ep: int, tm: dict,
                              interrupted: bool = False,
@@ -1741,6 +1850,17 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         last_input_alert[0] = (record or {}).get("input_wait_alert")
         last_clock_skew[0] = ((record or {}).get("clock")
                               or {}).get("max_skew_s")
+        if slo_session is not None and record is not None:
+            # The SLO verdict for this epoch: pure local arithmetic on
+            # the already-pod-aggregated record (no collective).
+            # Breaches are events + TB markers + a loud line; the
+            # session status rides status.json and the exporter.
+            for b in slo_session.evaluate(record):
+                telem.slo_breach(b)
+                print(slo_lib.describe_breach(b)
+                      + " — docs/OPERATIONS.md 'Monitoring, SLOs, "
+                        "and regression gating'", flush=True)
+            last_slo[0] = slo_session.status()
         if status is not None:
             # Epoch-boundary status write: covers --log-every 0 runs
             # and adds the goodput the in-epoch writes can't know yet.
@@ -1775,7 +1895,30 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                 "restored": restored_info,
                 "health": (monitor.snapshot()
                            if monitor is not None else None),
+                # The live SLO verdict (breached objectives + run
+                # totals): the status CLI renders a loud line from it.
+                "slo": last_slo[0],
             })
+        if exporter is not None and record is not None:
+            # Refresh the serving snapshot: the exporter's thread
+            # renders scrapes from exactly this epoch-boundary state
+            # (the same numbers status.json just recorded).
+            exporter.update(export_lib.build_state(
+                run_info=exporter_info, record=record,
+                health=(monitor.snapshot()
+                        if monitor is not None else None),
+                slo=last_slo[0],
+                compile_counts=(dict(sentinel.counts)
+                                if sentinel is not None else None),
+                peer_staleness=(pod.peer_staleness()
+                                if pod is not None else None),
+                totals={"rollbacks": rollbacks,
+                        "ckpt_commit_failures": ckpt_commit_failures}))
+        if sentinel is not None:
+            # First boundary reached: compiles from here on are either
+            # bracketed first-time geometries or genuine mid-run
+            # recompiles. Idempotent.
+            sentinel.end_warmup()
 
     ckpt_commit_failures = 0  # pod-agreed failed async commits
     ckpt_fail_streak = 0      # consecutive — the storage-outage verdict
@@ -1838,6 +1981,8 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
     rollback_streak = 0  # consecutive incidents — the give-up budget
     epoch = start_epoch
     warm = None  # next epoch's pre-started input pipeline
+    first_eval_done = False  # the first eval epoch's compile is
+    #                          EXPECTED by the recompile sentinel
 
     def _pod_gate(phase: str) -> None:
         """Degraded-pod check before each pod-agreed phase: a dead peer
@@ -2087,8 +2232,17 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                         or epoch == cfg.epochs - 1)
             if did_eval:
                 _pod_gate("eval")
-                val_m, val_t = evaluate(cfg, mesh, eval_step, state,
-                                        val_loader, epoch, telem)
+                # The FIRST eval epoch compiles the eval geometry —
+                # with --eval-every > 1 that lands after warmup ended,
+                # so the sentinel is told to expect it (a later,
+                # unexpected eval recompile still classifies midrun).
+                with (sentinel.expect("first-eval")
+                      if sentinel is not None and not first_eval_done
+                      else contextlib.nullcontext()):
+                    val_m, val_t = evaluate(cfg, mesh, eval_step,
+                                            state, val_loader, epoch,
+                                            telem)
+                first_eval_done = True
                 telem.phase("eval", val_t)
             else:
                 val_t = 0.0
@@ -2243,6 +2397,9 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
             "restored": restored_info,
             "health": (monitor.snapshot()
                        if monitor is not None else None),
+            # A run that FINISHED in breach must say so on its last
+            # status surface, not only in the event log.
+            "slo": last_slo[0],
         })
     summary = {"best_top1": best_top1, "best_top5": best_top5,
                "best_epoch": best_epoch, "total_minutes": total_min,

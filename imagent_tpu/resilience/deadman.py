@@ -277,6 +277,17 @@ class DeadmanMonitor:
                     if st["changed_at"] is not None and not st["done"]]
         return max(ages, default=0.0)
 
+    def peer_staleness(self) -> dict[int, float]:
+        """Per-peer heartbeat age on this monitor's local observation
+        clock (live peers only — clean departures excluded): the
+        metrics-exporter series a fleet scraper alerts on as any rank
+        creeps toward the deadline."""
+        now = time.monotonic()
+        with self._lock:
+            return {r: round(now - st["changed_at"], 3)
+                    for r, st in self._peers.items()
+                    if st["changed_at"] is not None and not st["done"]}
+
     # ---- monitor thread -------------------------------------------------
 
     def _tombstone_fresh(self, rec: dict, st: dict) -> bool:
@@ -532,6 +543,9 @@ class PodHeartbeat:
 
     def max_peer_staleness(self) -> float:
         return self.monitor.max_peer_staleness()
+
+    def peer_staleness(self) -> dict[int, float]:
+        return self.monitor.peer_staleness()
 
     def tombstone(self, reason: str, exit_code: int,
                   detail: str = "") -> bool:

@@ -63,6 +63,13 @@ Registered fault points (grep for ``faultinject.fire``):
   all still FINITE: drives the divergence early-warning detector
   (``telemetry/health.py``) and, with ``--health-rollback``, the
   rollback-before-the-non-finite-guard path (``make drill-divergence``).
+* ``step.shape_change`` (engine): crops one dispatch's batch spatially
+  by ``crop`` px (default 2) ON THE HOST and re-places it, so the
+  compiled train step sees a new input shape mid-run and silently
+  retraces — drives the runtime recompile sentinel
+  (``telemetry/recompile.py``): exactly ONE post-warmup
+  ``compile_event`` naming the step function, the `recompiles` SLO
+  breach, and the master WARN.
 * ``host.die`` (engine): abrupt ``os._exit`` mid-epoch — no tombstone,
   no cleanup, no signal handlers (the VM-reclaim / kernel-panic
   stand-in). Peers must detect this via heartbeat staleness alone

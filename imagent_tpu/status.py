@@ -257,6 +257,29 @@ def render(run_dir: str, now: float | None = None,
                 f"streak {iw.get('streak', 1)}) — host "
                 f"{iw.get('worst_host', '?')} slowest "
                 f"({_fmt(iw.get('worst_host_wait_s'), '.1f')}s)")
+        slo = st.get("slo")
+        if slo:
+            # The machine-checkable health verdict (telemetry/slo.py):
+            # a breached run must be as loud on the one-screen view as
+            # a degraded pod.
+            breached = slo.get("breached") or []
+            totals = slo.get("totals") or {}
+            if breached:
+                lines.append(
+                    "SLO: ** BREACHED ** last epoch failed "
+                    + ", ".join(breached)
+                    + (f" (run totals: "
+                       + ", ".join(f"{k} x{v}"
+                                   for k, v in sorted(totals.items()))
+                       + ")" if totals else ""))
+            elif slo.get("epochs_judged", 0) == 0:
+                lines.append("slo: armed (still in warmup — no epoch "
+                             "judged yet)")
+            else:
+                total_breaches = sum(totals.values())
+                lines.append(
+                    f"slo: OK — {slo.get('epochs_judged')} epoch(s) "
+                    f"judged, {total_breaches} breach-epoch(s) total")
         world = st.get("world_size")
         launched = st.get("launched_world_size")
         if world and launched and int(world) != int(launched):

@@ -172,6 +172,27 @@ class TelemetrySession:
         if self.writer is not None:
             self.writer.write("health_anomaly", info)
 
+    def slo_breach(self, info: dict) -> None:
+        """One SLO objective breached this epoch (telemetry/slo.py —
+        the engine evaluates on the master, against the already
+        pod-aggregated epoch record): written as an ``slo_breach``
+        event plus a TB marker series. Detail (value, threshold,
+        streak) rides the event; the status.json ``slo`` field carries
+        the session's standing verdict."""
+        if self.writer is not None:
+            self.writer.write("slo_breach", info)
+        if self.logger is not None:
+            self.logger.slo_breach(int(info.get("epoch", 0)),
+                                   str(info.get("objective", "?")))
+
+    def compile_event(self, info: dict) -> None:
+        """A post-warmup XLA recompile (telemetry/recompile.py): the
+        ``compile_event`` record names the jitted function and the
+        compile seconds — the forensic answer to a goodput dip the
+        phase taxonomy could only file under compile/step_drain."""
+        if self.writer is not None:
+            self.writer.write("compile_event", info)
+
     def pod_resized(self, info: dict) -> None:
         """An elastic resize took effect (or a grow stop is about to
         re-form the pod): written as a ``pod_resized`` event carrying

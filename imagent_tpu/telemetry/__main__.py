@@ -9,13 +9,22 @@ Subcommands:
   resumed run appends, so the LAST record per epoch wins.  Runs traced
   with ``--trace`` grow a trace column set (span counts + the top-3
   span names by total busy time per epoch) so a bad goodput epoch can
-  be explained without opening Perfetto.
+  be explained without opening Perfetto.  ``--json`` replaces the
+  human table with the machine-readable per-epoch document
+  (``SUMMARIZE_SCHEMA``, stable keys) so regress/CI/external tooling
+  stop parsing the table.
 * ``trace <run_dir>`` — merge the per-rank ``trace/trace.<rank>.jsonl``
   span files into one skew-corrected Chrome-trace-format
   ``trace/trace.json`` (pid = rank, tid = thread) that loads in
   Perfetto, validated against the trace event schema before it is
   written.  ``--top N`` additionally prints the N longest spans as
   text (docs/OPERATIONS.md "Reading a pod trace").
+* ``slo <run_dir> [--spec PATH]`` — replay the SLO evaluation
+  (``telemetry/slo.py``) over a finished run's epoch records; exit 1
+  on any breach (``make slo-check``'s body).
+* ``regress <run_dir> --baseline <run|BENCH json>`` — the noise-aware
+  cross-run performance regression gate (``telemetry/regress.py``);
+  exit 1 on regression, 3 on an incomparable environment.
 
 Pure JSONL post-processing — runs on any box with no accelerator
 stack (nothing here imports jax).  The exact table format is pinned by
@@ -26,11 +35,18 @@ may parse it.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 from imagent_tpu.telemetry import trace as trace_lib
-from imagent_tpu.telemetry.events import FILENAME, read_events
+from imagent_tpu.telemetry.events import (
+    FILENAME, fold_events, read_events,
+)
+
+# Version of the ``summarize --json`` document. Additions are not
+# bumps (consumers ignore unknown keys) — the events.py contract.
+SUMMARIZE_SCHEMA = 1
 
 _COLUMNS = ("epoch", "wall_s", "goodput", "input_s", "p95_ms",
             "bad", "anomal", "gnorm_ewma", "ratio_ewma", "hbm_gb")
@@ -58,19 +74,13 @@ def summarize(run_dir: str, ckpt_dir: str | None = None) -> str:
     path = os.path.join(run_dir, FILENAME)
     if not os.path.isfile(path):
         return f"no {FILENAME} under {run_dir}"
-    recs = read_events(path)
-    by_epoch: dict[int, dict] = {}
-    run_start = run_end = None
+    folded = fold_events(read_events(path))
+    by_epoch = folded["by_epoch"]  # last record per epoch wins
+    run_start, run_end = folded["run_start"], folded["run_end"]
     notable: list[str] = []
-    for rec in recs:
+    for rec in folded["others"]:
         ev = rec.get("event")
-        if ev == "epoch":
-            by_epoch[int(rec.get("epoch", -1))] = rec  # last wins
-        elif ev == "run_start":
-            run_start = rec
-        elif ev == "run_end":
-            run_end = rec
-        elif ev == "health_anomaly":
+        if ev == "health_anomaly":
             notable.append(
                 f"  health_anomaly: {rec.get('kind')} at epoch "
                 f"{int(rec.get('epoch', 0)) + 1} step {rec.get('step')}")
@@ -81,6 +91,16 @@ def summarize(run_dir: str, ckpt_dir: str | None = None) -> str:
                 f"{int(rec.get('epoch', 0)) + 1}"
                 + (" [elastic continue]" if rec.get("continue")
                    else ""))
+        elif ev == "slo_breach":
+            notable.append(
+                f"  slo_breach: {rec.get('objective')} = "
+                f"{rec.get('value')} vs {rec.get('threshold')} at "
+                f"epoch {int(rec.get('epoch', 0)) + 1} (streak "
+                f"{rec.get('streak', 1)})")
+        elif ev == "compile_event":
+            notable.append(
+                f"  compile_event: `{rec.get('fun')}` recompiled "
+                f"mid-run ({rec.get('secs')}s)")
         elif ev == "pod_resized":
             if rec.get("phase") == "grow-stop":
                 notable.append(
@@ -179,6 +199,64 @@ def summarize(run_dir: str, ckpt_dir: str | None = None) -> str:
     return "\n".join(lines)
 
 
+def summarize_json(run_dir: str, ckpt_dir: str | None = None) -> dict:
+    """The machine-readable ``summarize --json`` document: stable
+    top-level keys (``summarize_schema``, ``run``, ``epochs``,
+    ``events``, ``run_end``, ``checkpoint``) so regress, CI, and
+    external tooling consume a contract instead of parsing the human
+    table.  Per-epoch entries are the raw telemetry epoch records
+    (LAST record per epoch wins, resume semantics), event lines are
+    grouped by type in log order.  Raises ``FileNotFoundError`` when
+    the run has no telemetry log (the CLI maps that to exit 2)."""
+    path = os.path.join(run_dir, FILENAME)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {FILENAME} under {run_dir}")
+    folded = fold_events(read_events(path))
+    by_epoch = folded["by_epoch"]
+    run_start, run_end = folded["run_start"], folded["run_end"]
+    events: dict[str, list[dict]] = {}
+    for rec in folded["others"]:
+        events.setdefault(str(rec.get("event")), []).append(rec)
+    from imagent_tpu.telemetry.events import read_json
+    meta = read_json(os.path.join(
+        ckpt_dir if ckpt_dir is not None
+        else os.path.join(run_dir, "checkpoints"), "last_meta.json"))
+    return {
+        "summarize_schema": SUMMARIZE_SCHEMA,
+        "run": run_start,
+        "epochs": [by_epoch[e] for e in sorted(by_epoch)],
+        "events": events,
+        "run_end": run_end,
+        "checkpoint": meta,
+    }
+
+
+def slo_check(run_dir: str, spec_arg: str) -> int:
+    """The ``slo`` subcommand body (``make slo-check``): replay the
+    SLO evaluation over a finished run; exit 1 on any breach."""
+    from imagent_tpu.telemetry import slo as slo_lib
+
+    try:
+        spec = slo_lib.parse_spec_arg(spec_arg)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if spec is None:
+        print("slo: spec is 'off' — nothing to evaluate",
+              file=sys.stderr)
+        return 2
+    try:
+        breaches, judged = slo_lib.evaluate_run(run_dir, spec)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    for b in breaches:
+        print(slo_lib.describe_breach(b), flush=True)
+    print(f"slo: {len(breaches)} breach(es) over {judged} judged "
+          f"epoch(s) in {run_dir}", flush=True)
+    return 1 if breaches else 0
+
+
 def merge_trace(run_dir: str, out: str | None, top: int) -> int:
     """The ``trace`` subcommand body: merge, validate, write, report."""
     try:
@@ -220,6 +298,12 @@ def merge_trace(run_dir: str, out: str | None, top: int) -> int:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["regress"]:
+        # Dispatched wholesale: regress owns its own argparse surface
+        # (and its own exit-code classes, docs/OPERATIONS.md).
+        from imagent_tpu.telemetry import regress as regress_lib
+        return regress_lib.main(argv[1:])
     p = argparse.ArgumentParser(
         prog="python -m imagent_tpu.telemetry",
         description="Offline telemetry.jsonl / trace tooling")
@@ -231,6 +315,21 @@ def main(argv=None) -> int:
                     help="the run's --ckpt-dir, for the resume-point "
                          "line (emergency-salvage / mid-epoch "
                          "surfacing); default <run_dir>/checkpoints")
+    ps.add_argument("--json", action="store_true", default=False,
+                    help="machine-readable per-epoch document "
+                         "(stable schema) instead of the human table")
+    pl = sub.add_parser(
+        "slo", help="evaluate a finished run against an SLO spec "
+                    "(exit 1 on any breach)")
+    pl.add_argument("run_dir", help="the run's --log-dir")
+    pl.add_argument("--spec", default="default",
+                    help="'default' (built-in spec) or a JSON spec "
+                         "file (telemetry/slo.py)")
+    sub.add_parser(
+        "regress", add_help=False,
+        help="noise-aware cross-run performance regression gate "
+             "(exit 1 on regression; dispatched to "
+             "telemetry/regress.py — see `... regress --help`)")
     pt = sub.add_parser(
         "trace",
         help="merge per-rank trace files into a skew-corrected "
@@ -243,8 +342,18 @@ def main(argv=None) -> int:
                     help="also print the N longest spans as text")
     ns = p.parse_args(argv)
     if ns.cmd == "summarize":
+        if ns.json:
+            try:
+                doc = summarize_json(ns.run_dir, ckpt_dir=ns.ckpt_dir)
+            except FileNotFoundError as e:
+                print(str(e), file=sys.stderr)
+                return 2
+            print(json.dumps(doc), flush=True)
+            return 0
         print(summarize(ns.run_dir, ckpt_dir=ns.ckpt_dir), flush=True)
         return 0
+    if ns.cmd == "slo":
+        return slo_check(ns.run_dir, ns.spec)
     if ns.cmd == "trace":
         return merge_trace(ns.run_dir, ns.out, ns.top)
     return 2  # unreachable: argparse enforces the subcommand

@@ -22,6 +22,14 @@ Event types:
   non-finite guard would have noticed anything.
 * ``pod_degraded`` — the deadman's peer-death verdict (see
   ``TelemetrySession.pod_degraded``).
+* ``slo_breach`` — one SLO objective breached at an epoch boundary
+  (``telemetry/slo.py``): objective, observed value, threshold,
+  breach streak.  The offline gate (``telemetry slo`` / ``make
+  slo-check``) re-derives the same verdicts from the epoch records.
+* ``compile_event`` — a post-warmup XLA recompile caught by the
+  runtime sentinel (``telemetry/recompile.py``): the jitted
+  function's name and the compile seconds the step loop silently
+  paid.
 * ``run_end``    — run summary totals.
 
 Schema note: the ``health`` sub-record, the two event types above, and
@@ -146,6 +154,50 @@ class TelemetryWriter:
         if self._f is not None:
             self._f.close()
             self._f = None
+
+
+def fold_events(records: list[dict], warmup: int = 0) -> dict:
+    """The one resume-aware fold every offline reader shares: keep the
+    LAST record per epoch (a resumed run appends), pull out
+    run_start/run_end, and collect every other event in log order
+    under ``others``.  ``warmup`` additionally marks, per epoch, whether
+    its SURVIVING record was among the first ``warmup`` non-interrupted
+    epoch records of its attempt (each ``run_start`` resets the
+    countdown — every attempt recompiles, including a mid-epoch resume
+    that re-trains an epoch index already in the log; the exemption
+    follows the record that wins the fold, not the index).  Consumers:
+    ``telemetry summarize`` (+ ``--json``) and the regression gate —
+    the fold semantics are a contract and must not fork per tool.
+
+    Returns ``{"run_start", "run_end", "by_epoch", "exempt",
+    "others"}`` where ``exempt[epoch]`` is True when that epoch's
+    surviving record is warmup-exempt."""
+    run_start = run_end = None
+    by_epoch: dict[int, dict] = {}
+    exempt: dict[int, bool] = {}
+    others: list[dict] = []
+    countdown = warmup
+    for rec in records:
+        ev = rec.get("event")
+        if ev == "run_start":
+            run_start = rec
+            countdown = warmup
+        elif ev == "run_end":
+            run_end = rec
+        elif ev == "epoch":
+            epoch = int(rec.get("epoch", -1))
+            is_exempt = False
+            # Interrupted records never consume the exemption (they
+            # are excluded from judgement anyway — the slo.py rule).
+            if countdown > 0 and not rec.get("interrupted"):
+                countdown -= 1
+                is_exempt = True
+            by_epoch[epoch] = rec
+            exempt[epoch] = is_exempt
+        elif ev is not None:
+            others.append(rec)
+    return {"run_start": run_start, "run_end": run_end,
+            "by_epoch": by_epoch, "exempt": exempt, "others": others}
 
 
 def read_events(path: str) -> list[dict]:

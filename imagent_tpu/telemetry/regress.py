@@ -1,0 +1,355 @@
+"""Cross-run regression gating: ``python -m imagent_tpu.telemetry
+regress <run> --baseline <run | BENCH_*.json>``.
+
+Five generations of BENCH_*.json sit in the tree and "did PR N make
+training slower?" was still answered by a human diffing JSON.  This
+module is the automated gate: it extracts per-epoch performance series
+from two runs' ``telemetry.jsonl`` (or one run vs a bench driver
+record), compares them with NOISE-AWARE acceptance bands — the same
+order-statistic median-CI the bench estimator publishes
+(``imagent_tpu/utils/stats.py``, VERDICT r5 weak 1) — and exits
+non-zero on a regression, so CI can consume the verdict.
+
+Verdict rules:
+
+* **Median metrics** (goodput, step p50/p95/p99 cadence, input-wait
+  fraction, derived img/s/chip): candidate regresses when its median
+  is worse than the baseline's by more than ``--tolerance`` percent
+  AND the two medians' order-statistic CI bands are disjoint in the
+  worse direction — overlapping bands mean the delta is inside the
+  measured noise, not a verdict.
+* **Max metrics** (checkpoint blocking seconds): worst-case numbers,
+  compared as maxima with the tolerance plus an absolute floor (a
+  0.01 s -> 0.05 s jump is noise, not a regression).
+* The first epoch record of every attempt is warmup (compiles) and is
+  excluded, as are interrupted epochs — override with ``--warmup 0``.
+
+Environment gating (the nonsense-verdict guard): both sides carry an
+environment fingerprint — runs stamp device kind/count, world size,
+jax version and the wire dtype into ``run_start``; bench records carry
+``env`` (``bench.py``).  A comparison across different hardware,
+topology, arch, resolution or global batch is REFUSED loudly (exit 3)
+instead of producing a number; ``--allow-env-mismatch`` is the
+explicit override for deliberate cross-config studies.
+
+Exit codes (one per failure class, documented in docs/OPERATIONS.md):
+
+* 0 — no regression (differences inside the noise bands/tolerance)
+* 1 — REGRESSION: at least one metric worse beyond its band
+* 2 — unusable input (missing run dir / telemetry log / malformed
+  baseline, or too few comparable epochs)
+* 3 — incomparable environments (refused, no verdict)
+
+jax-free and stdlib+CI-helper only (asserted by ``tests/test_slo.py``)
+— the gate runs on any CI box with no accelerator stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from imagent_tpu.utils.stats import median, median_ci
+
+# (metric, direction, aggregate): direction is which way WORSE points;
+# aggregate "median" gets the CI-band rule, "max" the worst-case rule.
+METRICS = (
+    ("goodput", "higher_better", "median"),
+    ("img_s_per_chip", "higher_better", "median"),
+    ("step_p50_ms", "lower_better", "median"),
+    ("step_p95_ms", "lower_better", "median"),
+    ("step_p99_ms", "lower_better", "median"),
+    ("input_wait_frac", "lower_better", "median"),
+    ("ckpt_block_s", "lower_better", "max"),
+)
+
+# Environment fingerprint keys that must agree for a comparison to
+# mean anything. Keys absent on EITHER side (older logs) are skipped;
+# present-and-different refuses.
+ENV_KEYS = ("device_kind", "device_count", "process_count", "arch",
+            "image_size", "global_batch", "transfer_dtype")
+
+# Absolute floor for the max-aggregated checkpoint-blocking verdict.
+_CKPT_ABS_FLOOR_S = 0.5
+
+
+class RegressError(Exception):
+    """Unusable input (exit 2)."""
+
+
+class EnvMismatchError(Exception):
+    """Refused cross-environment comparison (exit 3)."""
+
+
+def load_run(run_dir: str, warmup: int = 1) -> dict:
+    """Per-epoch performance series + environment fingerprint from a
+    run dir's telemetry.jsonl.  Resume semantics ride the shared
+    ``events.fold_events`` contract: the LAST record per epoch wins,
+    the first ``warmup`` epoch records of EACH attempt are excluded
+    (every attempt recompiles — including a mid-epoch resume that
+    re-trains an epoch index already in the log), and interrupted
+    epochs never count."""
+    from imagent_tpu.telemetry.events import (
+        FILENAME, fold_events, read_events,
+    )
+
+    path = os.path.join(run_dir, FILENAME)
+    if not os.path.isfile(path):
+        raise RegressError(f"no {FILENAME} under {run_dir}")
+    folded = fold_events(read_events(path), warmup=warmup)
+    run_start = folded["run_start"] or {}
+    by_epoch = folded["by_epoch"]
+    env = {k: run_start.get(k) for k in ENV_KEYS}
+    global_batch = run_start.get("global_batch") or 0
+    device_count = run_start.get("device_count") or 0
+    series: dict[str, list[float]] = {m: [] for m, _d, _a in METRICS}
+    for epoch in sorted(by_epoch):
+        rec = by_epoch[epoch]
+        if folded["exempt"].get(epoch) or rec.get("interrupted"):
+            continue
+        phases = rec.get("phases") or {}
+        step = rec.get("step_ms") or {}
+        wall = float(rec.get("wall_s") or 0.0)
+        if rec.get("goodput") is not None:
+            series["goodput"].append(float(rec["goodput"]))
+        for key, name in (("p50_ms", "step_p50_ms"),
+                          ("p95_ms", "step_p95_ms"),
+                          ("p99_ms", "step_p99_ms")):
+            if step.get("n", 0) and step.get(key):
+                series[name].append(float(step[key]))
+        if wall > 0:
+            series["input_wait_frac"].append(
+                float(phases.get("input_wait", 0.0)) / wall)
+        if "checkpoint" in phases:
+            series["ckpt_block_s"].append(float(phases["checkpoint"]))
+        # Derived steady-state throughput: the p50 dispatch cadence IS
+        # the per-step wall on a saturated pipeline (sampler.py), so
+        # img/s/chip = global_batch / p50 / chips — comparable to the
+        # bench driver's step-only number (which also includes the
+        # in-graph input stage).
+        if step.get("n", 0) and step.get("p50_ms") and global_batch \
+                and device_count:
+            series["img_s_per_chip"].append(
+                float(global_batch) / (float(step["p50_ms"]) / 1e3)
+                / float(device_count))
+    return {"kind": "run", "path": run_dir, "env": env,
+            "series": series,
+            "epochs": len([e for e in by_epoch
+                           if not folded["exempt"].get(e)
+                           and not by_epoch[e].get("interrupted")])}
+
+
+def load_bench(path: str) -> dict:
+    """A bench driver record (BENCH_*.json / ``python bench.py``
+    output): the published img/s/chip with its CI becomes the
+    baseline band; the environment rides the ``env`` stamp (newer
+    records) with the legacy ``chip`` field as fallback."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise RegressError(f"unreadable bench record {path}: {e}")
+    if not isinstance(doc, dict) or "value" not in doc \
+            or "metric" not in doc:
+        raise RegressError(
+            f"{path} is not a bench record (no metric/value) — a "
+            "baseline must be a run dir or a bench.py JSON")
+    env = dict(doc.get("env") or {})
+    env.setdefault("device_kind", doc.get("chip"))
+    # arch/resolution ride the metric name:
+    # "<arch>_<size>_train_throughput_per_chip".
+    parts = str(doc["metric"]).split("_train_", 1)[0].rsplit("_", 1)
+    if len(parts) == 2 and parts[1].isdigit():
+        env.setdefault("arch", parts[0])
+        env.setdefault("image_size", int(parts[1]))
+    env = {k: env.get(k) for k in ENV_KEYS}
+    return {"kind": "bench", "path": path, "env": env,
+            "value": float(doc["value"]),
+            "ci": [float(x) for x in doc["ci_img_s"]]
+            if doc.get("ci_img_s") else None}
+
+
+def check_env(cand_env: dict, base_env: dict) -> list[str]:
+    """Mismatched fingerprint keys present on BOTH sides (a verdict
+    across these would be about the hardware, not the code)."""
+    out = []
+    for key in ENV_KEYS:
+        a, b = cand_env.get(key), base_env.get(key)
+        if a is not None and b is not None and a != b:
+            out.append(f"{key}: candidate {a!r} vs baseline {b!r}")
+    return out
+
+
+def _worse_by(direction: str, cand: float, base: float) -> float:
+    """Relative degradation in the WORSE direction (negative =
+    improved)."""
+    if base == 0:
+        return 0.0
+    delta = (base - cand) if direction == "higher_better" \
+        else (cand - base)
+    return delta / abs(base)
+
+
+def compare(cand: dict, base: dict, tolerance_pct: float = 5.0,
+            min_epochs: int = 1) -> dict:
+    """The verdict: ``{regressions, checked, skipped, notes}`` where
+    ``regressions`` is the list of metric findings that exceeded their
+    noise band."""
+    tol = tolerance_pct / 100.0
+    regressions: list[dict] = []
+    checked: list[dict] = []
+    skipped: list[str] = []
+    for metric, direction, agg in METRICS:
+        cs = cand["series"].get(metric) or []
+        if base["kind"] == "bench":
+            if metric != "img_s_per_chip":
+                continue
+            if len(cs) < min_epochs:
+                skipped.append(f"{metric}: candidate has "
+                               f"{len(cs)} usable epoch(s)")
+                continue
+            cand_med = median(cs)
+            c_lo, c_hi, _cov = median_ci(cs)
+            b_lo, b_hi = (base["ci"] if base["ci"]
+                          else (base["value"], base["value"]))
+            worse = _worse_by(direction, cand_med, base["value"])
+            disjoint = c_hi < b_lo  # slower beyond both bands
+            finding = {
+                "metric": metric, "aggregate": "median",
+                "candidate": round(cand_med, 3),
+                "baseline": round(base["value"], 3),
+                "candidate_band": [round(c_lo, 3), round(c_hi, 3)],
+                "baseline_band": [round(b_lo, 3), round(b_hi, 3)],
+                "worse_pct": round(100.0 * worse, 2),
+            }
+            checked.append(finding)
+            if worse > tol and disjoint:
+                regressions.append(finding)
+            continue
+        bs = base["series"].get(metric) or []
+        if len(cs) < min_epochs or len(bs) < min_epochs:
+            skipped.append(f"{metric}: {len(cs)} candidate / "
+                           f"{len(bs)} baseline usable epoch(s)")
+            continue
+        if agg == "max":
+            cand_v, base_v = max(cs), max(bs)
+            worse = _worse_by(direction, cand_v, base_v)
+            abs_delta = (cand_v - base_v
+                         if direction == "lower_better"
+                         else base_v - cand_v)
+            finding = {
+                "metric": metric, "aggregate": "max",
+                "candidate": round(cand_v, 3),
+                "baseline": round(base_v, 3),
+                "worse_pct": round(100.0 * worse, 2),
+            }
+            checked.append(finding)
+            if worse > tol and abs_delta > _CKPT_ABS_FLOOR_S:
+                regressions.append(finding)
+            continue
+        cand_med, base_med = median(cs), median(bs)
+        c_lo, c_hi, _ = median_ci(cs)
+        b_lo, b_hi, _ = median_ci(bs)
+        worse = _worse_by(direction, cand_med, base_med)
+        disjoint = (c_hi < b_lo if direction == "higher_better"
+                    else c_lo > b_hi)
+        finding = {
+            "metric": metric, "aggregate": "median",
+            "candidate": round(cand_med, 4),
+            "baseline": round(base_med, 4),
+            "candidate_band": [round(c_lo, 4), round(c_hi, 4)],
+            "baseline_band": [round(b_lo, 4), round(b_hi, 4)],
+            "worse_pct": round(100.0 * worse, 2),
+        }
+        checked.append(finding)
+        if worse > tol and disjoint:
+            regressions.append(finding)
+    return {"regressions": regressions, "checked": checked,
+            "skipped": skipped}
+
+
+def _load_baseline(path: str, warmup: int) -> dict:
+    if os.path.isdir(path):
+        return load_run(path, warmup=warmup)
+    if os.path.isfile(path):
+        return load_bench(path)
+    raise RegressError(f"baseline {path!r} is neither a run dir nor a "
+                       "bench JSON")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m imagent_tpu.telemetry regress",
+        description="Noise-aware cross-run performance regression "
+                    "gate over telemetry.jsonl")
+    p.add_argument("run_dir", help="candidate run's --log-dir")
+    p.add_argument("--baseline", required=True,
+                   help="baseline run dir, or a bench.py BENCH_*.json")
+    p.add_argument("--tolerance", type=float, default=5.0,
+                   metavar="PCT",
+                   help="relative degradation allowed before the "
+                        "noise bands are even consulted (default 5)")
+    p.add_argument("--warmup", type=int, default=1, metavar="N",
+                   help="first N epochs of each attempt excluded as "
+                        "compile warmup (default 1)")
+    p.add_argument("--allow-env-mismatch", action="store_true",
+                   default=False,
+                   help="compare anyway across different "
+                        "hardware/config (the verdict is then about "
+                        "the environment too — default: refuse)")
+    p.add_argument("--json", action="store_true", default=False,
+                   help="machine-readable verdict on stdout")
+    ns = p.parse_args(argv)
+    try:
+        cand = load_run(ns.run_dir, warmup=ns.warmup)
+        base = _load_baseline(ns.baseline, ns.warmup)
+    except RegressError as e:
+        print(f"regress: {e}", flush=True)
+        return 2
+    mismatches = check_env(cand["env"], base["env"])
+    if mismatches and not ns.allow_env_mismatch:
+        print("regress: REFUSED — candidate and baseline ran on "
+              "different environments; a verdict would be about the "
+              "hardware, not the code:", flush=True)
+        for m in mismatches:
+            print(f"  {m}", flush=True)
+        print("  (--allow-env-mismatch overrides for deliberate "
+              "cross-config studies)", flush=True)
+        return 3
+    verdict = compare(cand, base, tolerance_pct=ns.tolerance)
+    if not verdict["checked"]:
+        print("regress: no comparable metrics — "
+              + "; ".join(verdict["skipped"]), flush=True)
+        return 2
+    if ns.json:
+        print(json.dumps({
+            "candidate": ns.run_dir, "baseline": ns.baseline,
+            "tolerance_pct": ns.tolerance,
+            "env_mismatches": mismatches, **verdict}))
+    else:
+        for f in verdict["checked"]:
+            band = ""
+            if "candidate_band" in f:
+                band = (f" (bands {f['candidate_band']} vs "
+                        f"{f['baseline_band']})")
+            mark = ("REGRESSION" if f in verdict["regressions"]
+                    else "ok")
+            print(f"  {f['metric']:>16} [{f['aggregate']}]: "
+                  f"{f['candidate']} vs baseline {f['baseline']} "
+                  f"({f['worse_pct']:+.1f}% worse){band} — {mark}",
+                  flush=True)
+        for s in verdict["skipped"]:
+            print(f"  skipped: {s}", flush=True)
+        if mismatches:
+            print("  WARNING: env mismatches overridden: "
+                  + "; ".join(mismatches), flush=True)
+    if verdict["regressions"]:
+        names = ", ".join(f["metric"]
+                          for f in verdict["regressions"])
+        print(f"regress: REGRESSION in {names} (beyond "
+              f"{ns.tolerance:g}% + noise bands)", flush=True)
+        return 1
+    print("regress: no regression (differences inside noise bands)",
+          flush=True)
+    return 0

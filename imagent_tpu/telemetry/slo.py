@@ -1,0 +1,296 @@
+"""Declarative run-health SLOs evaluated at every epoch boundary.
+
+Every signal the telemetry subsystem produces — goodput phases, step
+percentiles, health EWMAs, heartbeat staleness, HBM — was until now
+judged by a human reading a table.  This module turns those numbers
+into an enforceable contract: a small, versioned spec of objectives
+("goodput >= 0.5", "step p99 <= 40 ms", "no post-warmup recompiles")
+evaluated against the per-epoch telemetry record the accountant /
+sampler / health monitor already produce.  Zero new step-loop cost:
+evaluation happens once per epoch on numbers that already exist.
+
+Spec document (JSON, ``--slo <path>``; ``--slo default`` uses
+``DEFAULT_SPEC``)::
+
+    {"slo_version": 1,
+     "warmup_epochs": 1,
+     "objectives": {"goodput_min": 0.5, "step_p99_ms_max": 0.0, ...}}
+
+Objective semantics:
+
+* ``*_min`` objectives breach when the observed value falls BELOW the
+  threshold; ``*_max`` objectives when it rises ABOVE it.
+* **Threshold objectives** (``goodput_min``, ``step_p99_ms_max``,
+  ``input_wait_frac_max``, ``ckpt_block_s_max``,
+  ``hb_staleness_s_max``, ``hbm_util_max``): ``0`` DISABLES the
+  objective — the repo-wide 0-disables flag convention.
+* **Count objectives** (``health_anomalies_max``,
+  ``recompiles_max``): ``0`` is a real (strict) threshold — "any
+  anomaly breaches" — so they disable with JSON ``null`` instead.
+* An objective whose observable is absent from the record (no HBM
+  stats on CPU, no deadman armed) is SKIPPED, not breached.
+* ``warmup_epochs``: the first N epoch records of each attempt are
+  exempt (first-epoch compiles crater goodput by design); a resumed
+  attempt restarts the exemption because it recompiles too.
+* Interrupted epochs (preemption mid-epoch) are never judged — their
+  partial wall partition is not a steady-state sample.
+
+Breaches carry a per-objective STREAK (consecutive breached epochs) so
+one noisy epoch is distinguishable from a regime.  The engine turns
+each breach into an ``slo_breach`` telemetry event, a TB marker, a
+status.json field, and a loud master print; ``python -m
+imagent_tpu.telemetry slo <run_dir>`` (``make slo-check``) replays the
+same evaluation offline and exits non-zero on any breach.
+
+This module sits on the epoch boundary and the offline CLI: it must
+stay jax-free (asserted by ``tests/test_slo.py``), stdlib-only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+SLO_SPEC_VERSION = 1
+
+# (objective, direction, kind) — direction "min" breaches below the
+# threshold, "max" above; kind "threshold" disables at 0, "count"
+# disables at null (0 is the strict "none allowed" contract).
+OBJECTIVES = (
+    ("goodput_min", "min", "threshold"),
+    ("step_p99_ms_max", "max", "threshold"),
+    ("input_wait_frac_max", "max", "threshold"),
+    ("ckpt_block_s_max", "max", "threshold"),
+    ("hb_staleness_s_max", "max", "threshold"),
+    ("hbm_util_max", "max", "threshold"),
+    ("health_anomalies_max", "max", "count"),
+    ("recompiles_max", "max", "count"),
+)
+_DIRECTION = {name: d for name, d, _k in OBJECTIVES}
+_KIND = {name: k for name, _d, k in OBJECTIVES}
+
+# The built-in production spec (``--slo default``): conservative bars
+# an honest TPU training pod should clear every steady-state epoch.
+# step_p99 and heartbeat staleness ship disabled — both are workload /
+# deployment numbers the operator must choose (docs/OPERATIONS.md
+# "Monitoring, SLOs, and regression gating").
+DEFAULT_SPEC = {
+    "slo_version": SLO_SPEC_VERSION,
+    "warmup_epochs": 1,
+    "objectives": {
+        "goodput_min": 0.5,
+        "step_p99_ms_max": 0.0,
+        "input_wait_frac_max": 0.15,
+        "ckpt_block_s_max": 30.0,
+        "hb_staleness_s_max": 0.0,
+        "hbm_util_max": 0.95,
+        "health_anomalies_max": 0,
+        "recompiles_max": 0,
+    },
+}
+
+
+def validate_spec(doc: dict) -> dict:
+    """Normalize + validate a spec document; raises ``ValueError`` with
+    the exact defect (a bad SLO file must fail the launch, not silently
+    judge nothing)."""
+    if not isinstance(doc, dict):
+        raise ValueError("SLO spec must be a JSON object")
+    version = doc.get("slo_version")
+    if version != SLO_SPEC_VERSION:
+        raise ValueError(
+            f"SLO spec version {version!r} not supported (this build "
+            f"understands slo_version={SLO_SPEC_VERSION})")
+    unknown = set(doc) - {"slo_version", "warmup_epochs", "objectives"}
+    if unknown:
+        raise ValueError(f"unknown SLO spec keys: {sorted(unknown)}")
+    warmup = doc.get("warmup_epochs", DEFAULT_SPEC["warmup_epochs"])
+    if not isinstance(warmup, int) or warmup < 0:
+        raise ValueError("warmup_epochs must be an integer >= 0")
+    objectives = doc.get("objectives", {})
+    if not isinstance(objectives, dict):
+        raise ValueError("objectives must be a JSON object")
+    known = {name for name, _d, _k in OBJECTIVES}
+    bad = set(objectives) - known
+    if bad:
+        raise ValueError(
+            f"unknown SLO objectives: {sorted(bad)} (known: "
+            f"{sorted(known)})")
+    out = {}
+    for name, value in objectives.items():
+        if value is None:
+            if _KIND[name] == "threshold":
+                raise ValueError(
+                    f"objective {name}: threshold objectives disable "
+                    "with 0, not null (null is the count-objective "
+                    "disable)")
+            out[name] = None
+            continue
+        if isinstance(value, bool) or not isinstance(value,
+                                                     (int, float)):
+            raise ValueError(f"objective {name}: threshold must be a "
+                             f"number, got {value!r}")
+        if float(value) < 0:
+            raise ValueError(f"objective {name}: threshold must be "
+                             ">= 0")
+        out[name] = float(value)
+    return {"slo_version": SLO_SPEC_VERSION, "warmup_epochs": warmup,
+            "objectives": out}
+
+
+def parse_spec_arg(arg: str) -> dict | None:
+    """The ``--slo`` flag: ``off`` (or empty) -> None, ``default`` ->
+    the built-in spec, anything else -> a JSON spec file path."""
+    arg = (arg or "").strip()
+    if arg in ("", "off"):
+        return None
+    if arg == "default":
+        return validate_spec(DEFAULT_SPEC)
+    if not os.path.isfile(arg):
+        raise ValueError(
+            f"--slo: no such spec file {arg!r} (use 'default', 'off', "
+            "or a JSON spec path)")
+    try:
+        with open(arg, encoding="utf-8") as f:
+            doc = json.load(f)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"--slo: {arg} is not valid JSON: {e}")
+    try:
+        return validate_spec(doc)
+    except ValueError as e:
+        raise ValueError(f"--slo: {arg}: {e}")
+
+
+def observables(record: dict) -> dict:
+    """Per-objective observed values from one epoch telemetry record
+    (``TelemetrySession.epoch_end``); absent observables map to None
+    (skipped, never breached)."""
+    phases = record.get("phases") or {}
+    counters = record.get("counters") or {}
+    wall = float(record.get("wall_s") or 0.0)
+    step = record.get("step_ms") or {}
+    out = {
+        "goodput_min": record.get("goodput"),
+        "step_p99_ms_max": (step.get("p99_ms")
+                            if step.get("n", 0) else None),
+        "input_wait_frac_max": (phases.get("input_wait", 0.0) / wall
+                                if wall > 0 else None),
+        "ckpt_block_s_max": phases.get("checkpoint"),
+        "hb_staleness_s_max": counters.get("hb_peer_staleness_s"),
+        "hbm_util_max": (record.get("hbm") or {}).get("utilization"),
+        "health_anomalies_max": counters.get("health_anomalies", 0.0),
+        "recompiles_max": counters.get("recompiles", 0.0),
+    }
+    return {k: (None if v is None else float(v))
+            for k, v in out.items()}
+
+
+def _enabled(name: str, threshold) -> bool:
+    if threshold is None:
+        return False
+    if _KIND[name] == "threshold" and float(threshold) == 0.0:
+        return False
+    return True
+
+
+class SloSession:
+    """One attempt's live SLO state: warmup countdown, per-objective
+    breach streaks, run totals.  ``evaluate`` is called once per epoch
+    boundary with the telemetry record — pure local arithmetic (the
+    record is already pod-aggregated; the verdict needs no
+    collective)."""
+
+    def __init__(self, spec: dict):
+        self.spec = validate_spec(spec)
+        self._warmup_left = int(self.spec["warmup_epochs"])
+        self._streaks: dict[str, int] = {}
+        self.totals: dict[str, int] = {}   # breached epochs / objective
+        self.epochs_judged = 0
+        self.last_breaches: list[dict] = []  # newest epoch's breaches
+
+    def evaluate(self, record: dict) -> list[dict]:
+        """Judge one epoch record; returns the breach list (empty when
+        healthy / warmup / interrupted).  Each breach:
+        ``{objective, value, threshold, epoch, streak}``."""
+        if record.get("interrupted"):
+            return []
+        if self._warmup_left > 0:
+            self._warmup_left -= 1
+            return []
+        self.epochs_judged += 1
+        obs = observables(record)
+        breaches = []
+        for name, _direction, _kind in OBJECTIVES:
+            threshold = self.spec["objectives"].get(name)
+            if not _enabled(name, threshold):
+                continue
+            value = obs.get(name)
+            if value is None:
+                continue
+            bad = (value < float(threshold)
+                   if _DIRECTION[name] == "min"
+                   else value > float(threshold))
+            if bad:
+                self._streaks[name] = self._streaks.get(name, 0) + 1
+                self.totals[name] = self.totals.get(name, 0) + 1
+                breaches.append({
+                    "objective": name,
+                    "value": round(value, 6),
+                    "threshold": float(threshold),
+                    "epoch": int(record.get("epoch", -1)),
+                    "streak": self._streaks[name],
+                })
+            else:
+                self._streaks[name] = 0
+        self.last_breaches = breaches
+        return breaches
+
+    def status(self) -> dict:
+        """The status.json / exporter surface: which objectives the
+        newest judged epoch breached, run totals, and how many epochs
+        have been judged (0 = still in warmup)."""
+        return {
+            "spec_version": self.spec["slo_version"],
+            "epochs_judged": self.epochs_judged,
+            "breached": [b["objective"] for b in self.last_breaches],
+            "last_breaches": self.last_breaches,
+            "totals": dict(sorted(self.totals.items())),
+        }
+
+
+def describe_breach(b: dict) -> str:
+    """One loud human line per breach (master print + status CLI)."""
+    op = "<" if _DIRECTION.get(b.get("objective", ""), "max") == "min" \
+        else ">"
+    return (f"SLO BREACH epoch {int(b.get('epoch', -1)) + 1}: "
+            f"{b.get('objective')} = {b.get('value')} {op} threshold "
+            f"{b.get('threshold')} (streak {b.get('streak', 1)})")
+
+
+def evaluate_run(run_dir: str, spec: dict) -> tuple[list[dict], int]:
+    """Offline replay over a finished run's telemetry.jsonl (``make
+    slo-check``): returns ``(breaches, epochs_judged)``.  Each
+    ``run_start`` record resets the warmup exemption — every attempt
+    recompiles.  Raises ``FileNotFoundError`` when the run has no
+    telemetry log."""
+    from imagent_tpu.telemetry.events import FILENAME, read_events
+
+    path = os.path.join(run_dir, FILENAME)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {FILENAME} under {run_dir}")
+    session = None
+    breaches: list[dict] = []
+    judged = 0
+    for rec in read_events(path):
+        ev = rec.get("event")
+        if ev == "run_start":
+            if session is not None:
+                judged += session.epochs_judged
+            session = SloSession(spec)
+        elif ev == "epoch":
+            if session is None:  # torn head: no run_start survived
+                session = SloSession(spec)
+            breaches.extend(session.evaluate(rec))
+    if session is not None:
+        judged += session.epochs_judged
+    return breaches, judged

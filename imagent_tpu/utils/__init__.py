@@ -1,1 +1,21 @@
-from imagent_tpu.utils.metrics import AverageMeter, accuracy, topk_correct  # noqa: F401
+"""utils package. The metric helpers are re-exported LAZILY (PEP 562):
+``utils.metrics`` imports jax.numpy, but jax-free consumers —
+``utils.stats`` feeds the regression gate (telemetry/regress.py),
+which must run on login/CI boxes with no accelerator stack — must be
+able to import through this package without dragging jax in (the
+data/prefetch.py lazy-import treatment; asserted by
+tests/test_slo.py)."""
+
+_METRIC_NAMES = ("AverageMeter", "accuracy", "topk_correct")
+
+
+def __getattr__(name):
+    if name in _METRIC_NAMES:
+        from imagent_tpu.utils import metrics
+        return getattr(metrics, name)
+    raise AttributeError(f"module {__name__!r} has no attribute "
+                         f"{name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_METRIC_NAMES))

@@ -147,6 +147,12 @@ class TrainLogger:
                          counters.get("health_anomalies", 0), epoch)
             w.add_scalar("health/bad_steps",
                          counters.get("bad_steps", 0), epoch)
+        if "recompiles" in counters:
+            # Post-warmup recompiles this epoch (the recompile
+            # sentinel): any nonzero point is a step-loop stall the
+            # goodput curve alone would misattribute.
+            w.add_scalar("compile/midrun_recompiles",
+                         counters["recompiles"], epoch)
         if "hb_peer_staleness_s" in counters:
             # Peak peer-heartbeat age the deadman saw this epoch:
             # trending toward --peer-deadline-secs = a host about to be
@@ -160,6 +166,14 @@ class TrainLogger:
             w.add_scalar("pod/world_size", counters["world_size"],
                          epoch)
         w.flush()
+
+    def slo_breach(self, epoch: int, objective: str) -> None:
+        """Marker for one breached SLO objective at this epoch (the
+        detail lives in telemetry.jsonl's ``slo_breach`` event)."""
+        if self.writer is None:
+            return
+        self.writer.add_scalar(f"slo/{objective}", 1.0, epoch)  # jaxlint: disable=telemetry-tag-format -- tag family bounded by the fixed slo.OBJECTIVES taxonomy, not per-step values
+        self.writer.flush()
 
     def pod_resized(self, epoch: int, world: int) -> None:
         """Marker for an elastic resize: the pod re-formed at ``world``

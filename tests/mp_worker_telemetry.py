@@ -53,11 +53,19 @@ def main() -> int:
     # the parent merges them into one skew-corrected Perfetto trace
     # spanning both ranks and >= 3 subsystems (engine phases, the
     # checkpoint committer thread, data staging).
+    # slo="default" + IMAGENT_MP_METRICS_PORT: the SLO engine judges
+    # each epoch record and process 0 serves the live OpenMetrics
+    # endpoint the PARENT scrapes mid-run (the acceptance drill for
+    # telemetry/export.py — a real fleet-scraper pull against a real
+    # 2-process engine run).
+    metrics_port = int(os.environ.get("IMAGENT_MP_METRICS_PORT",
+                                      "0") or 0)
     cfg = Config(arch="resnet18", image_size=16, num_classes=4,
                  batch_size=4, epochs=2, lr=0.05, dataset="synthetic",
                  synthetic_size=64, workers=0, bf16=False, log_every=2,
                  seed=0, save_model=True, keep_last_k=1, backend="cpu",
-                 eval_every=2, trace="phases",
+                 eval_every=2, trace="phases", slo="default",
+                 metrics_port=metrics_port,
                  log_dir=os.path.join(scratch, "tb"),
                  ckpt_dir=os.path.join(scratch, "ck"))
     result = run(cfg)

@@ -564,6 +564,43 @@ def test_telemetry_tag_good_silent(tmp_path):
                     rule="telemetry-tag-format") == []
 
 
+OM_FAMILY_BAD = """
+def render(exp, items):
+    for name, v in items:
+        exp.family(f"imagent_{name}", "gauge", "per-item").sample(v)
+    exp.family("Imagent-Goodput", "counter", "bad grammar").sample(1)
+    exp.family("goodput/fraction", "gauge", "tb-style slash").sample(1)
+"""
+
+OM_FAMILY_GOOD = """
+def render(exp, phases):
+    fam = exp.family("imagent_goodput_phase_seconds", "gauge", "x")
+    for name, secs in phases.items():
+        fam.sample(secs, phase=name)  # variables belong in LABELS
+    exp.family("imagent_up", "gauge", "liveness").sample(1)
+    # Unrelated .family() methods (no literal metric type in arg 2)
+    # are out of scope for this rule.
+    taxonomy.family("Whatever Case", object(), "not an exporter")
+"""
+
+
+def test_exporter_family_fstring_and_grammar_fire(tmp_path):
+    """The exporter half of the rule (ISSUE 15 satellite): family
+    names handed to Exposition.family must be literal snake_case —
+    an f-string mints one metric family per interpolated value, and
+    slashes/capitals break the Prometheus naming grammar."""
+    findings = lint_src(tmp_path, OM_FAMILY_BAD,
+                        rule="telemetry-tag-format")
+    assert len(findings) == 3
+    assert any("f-string" in f.message for f in findings)
+    assert sum("snake_case" in f.message for f in findings) == 2
+
+
+def test_exporter_family_good_silent(tmp_path):
+    assert lint_src(tmp_path, OM_FAMILY_GOOD,
+                    rule="telemetry-tag-format") == []
+
+
 # -------------------------------------------------------------- rule 9
 
 STEP_LOOP_BAD = """

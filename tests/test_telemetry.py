@@ -358,13 +358,43 @@ def test_pod_telemetry_two_process_engine_run(tmp_path):
     pod-aggregated per-host stats (hosts.count == 2 — the allgather
     crossed the process boundary) and goodput phases summing to >=95%
     of the measured epoch wall."""
-    from mp_launch import launch_pair
+    import threading
+    import urllib.request
 
+    from mp_launch import free_port, launch_pair
+
+    # Live OpenMetrics scrape (ISSUE 15 acceptance): the PARENT
+    # polls process 0's --metrics-port WHILE the pod trains and keeps
+    # the last exposition that carries epoch-boundary series — a real
+    # fleet-scraper pull against a live run, not a post-mortem read.
+    metrics_port = free_port()
+    scraped = {"text": None, "any": None}
+    stop_scraping = threading.Event()
+
+    def _scrape_loop():
+        url = f"http://127.0.0.1:{metrics_port}/metrics"
+        while not stop_scraping.is_set():
+            try:
+                body = urllib.request.urlopen(url, timeout=2) \
+                    .read().decode("utf-8")
+                scraped["any"] = body
+                if "imagent_goodput_ratio" in body:
+                    scraped["text"] = body  # boundary state is live
+            except OSError:
+                pass  # run not up yet / between process lifetimes
+            stop_scraping.wait(0.2)
+
+    scraper = threading.Thread(target=_scrape_loop, daemon=True)
     os.environ["IMAGENT_MP_SCRATCH"] = str(tmp_path)
+    os.environ["IMAGENT_MP_METRICS_PORT"] = str(metrics_port)
+    scraper.start()
     try:
         outs = launch_pair("mp_worker_telemetry.py")
     finally:
+        stop_scraping.set()
+        scraper.join(timeout=10)
         del os.environ["IMAGENT_MP_SCRATCH"]
+        del os.environ["IMAGENT_MP_METRICS_PORT"]
     for out in outs:
         assert "RUN_OK" in out, out
 
@@ -475,6 +505,36 @@ def test_pod_telemetry_two_process_engine_run(tmp_path):
     assert proc.returncode == 0, proc.stderr + proc.stdout
     assert "clock skew: max" in proc.stdout, proc.stdout
     assert (tmp_path / "tb" / "trace" / "trace.json").is_file()
+
+    # ---- live OpenMetrics scrape (ISSUE 15 acceptance): the parent
+    # really pulled valid exposition text off the serving thread
+    # MID-RUN, and it carries the goodput / step-percentile / health /
+    # pod / slo families.
+    from imagent_tpu.telemetry import export as export_lib
+    text = scraped["text"]
+    assert text is not None, (
+        "parent never scraped a boundary-state exposition mid-run "
+        f"(last scrape: {str(scraped['any'])[:400]!r})")
+    assert export_lib.validate_exposition(text) == []
+    samples = export_lib.parse_samples(text)
+    assert samples["imagent_goodput_ratio"][()] > 0.0
+    assert (("quantile", "0.5"),) in \
+        samples["imagent_step_time_seconds"]
+    assert any(k.startswith("imagent_health_ewma")
+               for k in samples), sorted(samples)
+    assert samples["imagent_pod_world_size"][()] == 2.0
+    assert "imagent_slo_epochs_judged" in samples
+    assert (("objective", "goodput_min"),) in \
+        samples["imagent_slo_breached"]
+    assert samples["imagent_up"][()] == 1.0
+    # The SLO engine judged the run (epoch 0 exempt as warmup), its
+    # standing verdict rode status.json, and the status CLI renders a
+    # slo line from it; breaches (if any on this contended CPU box)
+    # are slo_breach events, not failures here.
+    assert (st.get("slo") or {}).get("spec_version") == 1
+    from imagent_tpu import status as status_lib
+    rendered = status_lib.render(str(tmp_path / "tb"))
+    assert "slo" in rendered.lower() or "SLO" in rendered, rendered
 
 
 def test_input_wait_alert_fraction_and_streak(tmp_path):
