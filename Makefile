@@ -5,8 +5,8 @@ PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
 
 .PHONY: smoke test lint bench-smoke bench-anatomy bench-input \
-	drill-pod drill-divergence drill-elastic drill-sharded trace-smoke \
-	slo-check slo-smoke
+	drill-pod drill-divergence drill-elastic drill-sharded drill-tp \
+	trace-smoke slo-check slo-smoke
 
 # Static-analysis gate (docs/STATIC_ANALYSIS.md): jaxlint — the
 # JAX/TPU-aware rules in imagent_tpu/analysis — over the package, the
@@ -69,6 +69,20 @@ drill-divergence:
 # elastic-flag validation. All tier-1.
 drill-elastic:
 	$(PYTEST) -m "not slow" tests/test_elastic.py
+
+# Model-parallel pod suite (docs/OPERATIONS.md "Model-parallel pods:
+# groups, death, and resize" — ISSUE 16's done bar): the group-math
+# units (rank->group, group-aligned roster commits, accum
+# re-derivation), the deadman group-condemnation verdicts, the
+# TP-vs-DP health-series parity pin, and THE acceptance drill — a REAL
+# 4-process --tp 2 pod loses a whole model group mid-epoch
+# (group.die), the survivors condemn the group, salvage from the
+# surviving whole group, re-form a one-group world (accum re-derived
+# under --global-batch), a fresh 4-process resume re-expands to two
+# groups, and the final loss matches the uninterrupted run within 1%
+# with no sample replayed or skipped. All tier-1.
+drill-tp:
+	$(PYTEST) -m "not slow" tests/test_groups.py tests/test_tp_pod.py
 
 # Sharded-state resilience suite (docs/OPERATIONS.md "Sharded
 # checkpoints and salvage coverage" — ROADMAP item 2's done bar): the

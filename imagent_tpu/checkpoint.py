@@ -111,6 +111,11 @@ _META_FIELDS = (
     # restoring instead of presenting a salvage as a clean LAST.
     ("device_count", np.int64, 0),
     ("emergency", np.int64, 0),
+    # Model-axis addition (appended; older checkpoints default 0 and
+    # the engine falls back to device_count): the writing pod's DATA
+    # degree — on a tp/pp mesh it is device_count / replica size, and
+    # the resized-resume accum report needs the real value.
+    ("data_parallel", np.int64, 0),
 )
 
 _ckptr: ocp.StandardCheckpointer | None = None

@@ -163,7 +163,8 @@ def initialize(backend: str | None = None,
                env: Mapping[str, str] | None = None,
                port: int | None = None,
                elastic_dir: str | None = None,
-               elastic_settle: float = 10.0) -> SlurmEnv | None:
+               elastic_settle: float = 10.0,
+               group_size: int = 1) -> SlurmEnv | None:
     """Initialize the distributed runtime.
 
     Replaces ``imagenet.py:237-273``: under Slurm with >1 task, call
@@ -229,7 +230,7 @@ def initialize(backend: str | None = None,
             from imagent_tpu import elastic as elastic_lib
             ros = elastic_lib.rendezvous(
                 elastic_dir, senv.global_rank, senv.world_size, port,
-                settle_secs=elastic_settle)
+                settle_secs=elastic_settle, group_size=group_size)
             members = [int(r) for r in ros["members"]]
             active_rank = members.index(senv.global_rank)
             senv = dataclasses.replace(

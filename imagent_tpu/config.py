@@ -280,6 +280,15 @@ class Config:
     # is first-class in the mesh design (SURVEY §2c disposition) even though
     # the parity workload only uses the data axis.
     model_parallel: int = 1
+    # Mesh-axis shorthand (the production spelling for model-axis pods):
+    # --tp N == --tensor-parallel --model-parallel N; --pp N ==
+    # --pipeline-parallel N; --dp N asserts the resulting data-parallel
+    # degree (refused loudly on mismatch instead of silently resharding).
+    # 0 = unset; the engine resolves these into the legacy fields before
+    # any validation, and refuses mixed spellings.
+    tp: int = 0
+    pp: int = 0
+    dp: int = 0
     # Sequence parallelism over the model axis (ViT only):
     # none | ring (ring attention) | ulysses (all-to-all head exchange).
     seq_parallel: str = "none"
@@ -364,8 +373,9 @@ def build_parser() -> argparse.ArgumentParser:
                             "resnet101", "resnet152", "resnext50_32x4d",
                             "resnext101_32x8d", "wide_resnet50_2",
                             "wide_resnet101_2", "vit_b16", "vit_l16",
-                            "vit_h14", "convnext_tiny", "convnext_small",
-                            "convnext_base", "convnext_large"])
+                            "vit_h14", "vit_debug", "convnext_tiny",
+                            "convnext_small", "convnext_base",
+                            "convnext_large"])
     p.add_argument("--image-size", type=int, default=c.image_size)
     p.add_argument("--num-classes", type=int, default=c.num_classes)
     p.add_argument("--data-root", type=str, default=c.data_root)
@@ -592,6 +602,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "partial roster after this long with no new "
                         "joiner (full world commits immediately)")
     p.add_argument("--model-parallel", type=int, default=c.model_parallel)
+    p.add_argument("--tp", type=int, default=c.tp, metavar="N",
+                   help="tensor-parallel degree (shorthand for "
+                        "--tensor-parallel --model-parallel N); model "
+                        "groups of N devices jointly hold one replica")
+    p.add_argument("--pp", type=int, default=c.pp, metavar="N",
+                   help="pipeline-parallel degree (shorthand for "
+                        "--pipeline-parallel N), composable with --tp")
+    p.add_argument("--dp", type=int, default=c.dp, metavar="N",
+                   help="expected data-parallel degree; validated "
+                        "against world size / replica size (0 = infer)")
     p.add_argument("--seq-parallel", type=str, default=c.seq_parallel,
                    choices=["none", "ring", "ulysses"])
     p.add_argument("--tensor-parallel", action="store_true", default=False,

@@ -47,6 +47,7 @@ import re
 import socket
 import time
 
+from imagent_tpu.groups import aligned_members as _aligned
 from imagent_tpu.resilience import exitcodes
 from imagent_tpu.telemetry.events import read_json, write_json_atomic
 
@@ -198,7 +199,8 @@ def _publish(edir: str, attempt: int, joiners: dict[int, dict],
 def rendezvous(edir: str, rank: int, launched_world: int,
                base_port: int, settle_secs: float = 10.0,
                patience_secs: float | None = None,
-               host: str | None = None, out=None) -> dict:
+               host: str | None = None, out=None,
+               group_size: int = 1) -> dict:
     """Join the next rendezvous round and return the committed roster
     this host is a member of.
 
@@ -213,7 +215,19 @@ def rendezvous(edir: str, rank: int, launched_world: int,
       ``IMAGENT_ELASTIC_PATIENCE_SECS``, default
       ``max(300, 10 x settle)``) it raises
       ``exitcodes.ElasticExcludedError`` for the requeue wrapper.
+    * ``group_size`` > 1 (model-axis pods, ``imagent_tpu/groups.py``):
+      rosters are GROUP-ALIGNED — the leader commits only ranks whose
+      entire model group joined. A partial group can never join (its
+      replica would be incomplete); its ranks stand as grow requests
+      until the whole group is present, and ride the exclusion path
+      above when it never is.
     """
+    group_size = max(int(group_size), 1)
+    if launched_world and int(launched_world) % group_size:
+        raise ValueError(
+            f"launched world {launched_world} does not divide into "
+            f"whole model groups of {group_size} rank(s); an elastic "
+            "model-axis pod must be launched group-aligned")
     os.makedirs(edir, exist_ok=True)
     host = host or this_host()
     if patience_secs is None:
@@ -306,9 +320,28 @@ def rendezvous(edir: str, rank: int, launched_world: int,
             if eligible and min(eligible) == int(rank):
                 if len(joiners) >= int(launched_world) \
                         or now - last_change >= settle_secs:
-                    ros = _publish(edir, attempt, joiners, base_port,
-                                   launched_world)
-                    continue  # loop re-reads: winner or adopted roster
+                    # Group alignment: commit only whole model groups.
+                    # The leader itself may fall out here (its partner
+                    # died) — it then publishes the survivors' roster
+                    # and stands as a grow request like any other
+                    # excluded rank. An empty aligned set publishes
+                    # nothing: keep waiting for a whole group.
+                    commit = joiners
+                    if group_size > 1:
+                        whole = set(_aligned(joiners, group_size))
+                        commit = {r: rec for r, rec in joiners.items()
+                                  if int(r) in whole}
+                        if set(commit) != set(joiners):
+                            say(f"elastic: attempt {attempt} joiners "
+                                f"{sorted(joiners)} are not "
+                                f"group-aligned (groups of "
+                                f"{group_size}); committing "
+                                f"{sorted(commit) or 'nothing'}")
+                    if commit:
+                        ros = _publish(edir, attempt, commit, base_port,
+                                       launched_world)
+                        continue  # loop re-reads: winner or adopted
+                    last_change = now  # re-arm the settle window
             time.sleep(poll)
     finally:
         if not committed:

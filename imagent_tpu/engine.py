@@ -34,6 +34,7 @@ import numpy as np
 from imagent_tpu import checkpoint as ckpt_lib
 from imagent_tpu import cluster
 from imagent_tpu import elastic as elastic_lib
+from imagent_tpu import groups as groups_lib
 from imagent_tpu.config import Config
 from imagent_tpu.data import make_loaders
 from imagent_tpu.data.pipeline import WIRE_DTYPES
@@ -406,6 +407,25 @@ def train_one_epoch(cfg: Config, mesh, train_step, state: TrainState,
                     print("FAULT host.die: hard-exiting this host now",
                           flush=True)
                     os._exit(int(f.get("code", 1)))
+                f = faultinject.fire("group.die")
+                if f is not None:
+                    # Model-group loss: every armed rank in the TARGET
+                    # rank's model group hard-exits — tombstone-free
+                    # like host.die, standing in for a shared failure
+                    # domain (one VM holding a whole TP pair, a rack
+                    # power event). Arm on every rank; only the target's
+                    # group dies. Params: rank=R (default: this rank),
+                    # code=C.
+                    me = (pod.rank if pod is not None
+                          else jax.process_index())
+                    target = int(f.get("rank", me))
+                    mine = (pod.group_for(me) if pod is not None
+                            else [me])
+                    if target in mine:
+                        print(f"FAULT group.die: rank {me} is in dead "
+                              f"group {sorted(mine)} — hard-exiting "
+                              "this host now", flush=True)
+                        os._exit(int(f.get("code", 1)))
             if pod is not None:
                 # Re-check right before the dispatch: the stall/fault
                 # window above (or a long input wait) may have slept
@@ -723,6 +743,29 @@ def run(cfg: Config, stop_check=None) -> dict:
     lost rank — the loss trajectory follows the batch, not the world
     size. Grow rides join requests + the pod-agreed stop
     (docs/OPERATIONS.md "Elastic pod")."""
+    # Mesh-axis shorthand (--tp/--pp/--dp, the production spelling for
+    # model-axis pods) resolves into the legacy fields BEFORE any
+    # validation so every downstream check sees one spelling.
+    if cfg.tp < 0 or cfg.pp < 0 or cfg.dp < 0:
+        raise ValueError("--tp/--pp/--dp must be >= 0 (0 = unset)")
+    if cfg.tp:
+        if cfg.tensor_parallel or cfg.model_parallel > 1:
+            raise ValueError(
+                "--tp N is the shorthand for --tensor-parallel "
+                "--model-parallel N; pass one spelling, not both")
+        if cfg.tp < 2:
+            raise ValueError("--tp must be >= 2 (a 1-wide tensor axis "
+                             "is plain DP; drop --tp)")
+        cfg = cfg.replace(tensor_parallel=True, model_parallel=cfg.tp)
+    if cfg.pp:
+        if cfg.pipeline_parallel > 1:
+            raise ValueError(
+                "--pp N is the shorthand for --pipeline-parallel N; "
+                "pass one spelling, not both")
+        if cfg.pp < 2:
+            raise ValueError("--pp must be >= 2 (a 1-stage pipeline is "
+                             "no pipeline; drop --pp)")
+        cfg = cfg.replace(pipeline_parallel=cfg.pp)
     # Elastic-pod flag contract, validated BEFORE any distributed init
     # (a bad combination must fail on the launch host, not at pod
     # rendezvous time).
@@ -744,15 +787,16 @@ def run(cfg: Config, stop_check=None) -> dict:
                 "contract). Set --global-batch to the fixed "
                 "optimization batch; grad accumulation absorbs the "
                 "lost/regained hosts.")
-        if (cfg.tensor_parallel or cfg.seq_parallel != "none"
-                or cfg.pipeline_parallel > 1 or cfg.expert_parallel
-                or cfg.model_parallel > 1):
+        if cfg.seq_parallel != "none" or cfg.expert_parallel:
             raise ValueError(
-                "--elastic supports the data-parallel family (plain "
-                "DP, --fsdp, --zero1 — sharded snapshots reshard onto "
-                "the resized mesh at restore); model-axis meshes "
-                "(tp/sp/pp/ep) change the mesh SHAPE itself on a host "
-                "loss and cannot resize over the data-parallel path")
+                "--elastic supports plain DP, --fsdp, --zero1, and "
+                "the tensor/pipeline meshes (--tp/--pp: one dead rank "
+                "condemns its whole model group, survivors shrink by "
+                "whole groups, and sharded snapshots reshard onto the "
+                "resized mesh); seq-parallel and expert-parallel stay "
+                "refused — their token/expert routing re-partitions "
+                "activation state across the model axis and no "
+                "group-aligned salvage covers it yet")
         if cfg.elastic_settle_secs <= 0:
             raise ValueError("--elastic-settle-secs must be > 0")
     if cfg.ckpt_format not in ("snapshot", "orbax"):
@@ -764,6 +808,13 @@ def run(cfg: Config, stop_check=None) -> dict:
             "Orbax path cannot land a collective-free emergency "
             "salvage or reshard a sharded checkpoint onto the "
             "resized mesh")
+    if (cfg.ckpt_format == "orbax"
+            and (cfg.model_parallel > 1 or cfg.pipeline_parallel > 1)):
+        raise ValueError(
+            "--ckpt-format orbax does not cover model-axis meshes "
+            "(tp/pp leaves shard across the mesh and the legacy Orbax "
+            "path has no sharded save/restore or salvage coverage "
+            "rule); use --ckpt-format snapshot")
     # SLO / exporter flag contract (telemetry/slo.py + export.py): a
     # bad spec or port must fail on the launch host, before any
     # distributed init.
@@ -784,11 +835,37 @@ def run(cfg: Config, stop_check=None) -> dict:
     # env — a requeued pod missing a host re-forms at N-1 instead of
     # timing out, and the full relaunch re-expands.
     elastic_kw = {}
+    # Processes per model group (the set of ranks jointly holding one
+    # model replica). The rendezvous runs BEFORE the JAX backend exists,
+    # so the pre-init value uses the IMAGENT_LOCAL_DEVICES hint; the
+    # real local device count re-verifies it right after init.
+    group_size_hint = groups_lib.process_group_size(
+        cfg.model_parallel, cfg.pipeline_parallel,
+        groups_lib.env_local_devices())
     if cfg.elastic:
         elastic_kw = dict(
             elastic_dir=elastic_lib.elastic_dir(cfg.log_dir),
-            elastic_settle=cfg.elastic_settle_secs)
+            elastic_settle=cfg.elastic_settle_secs,
+            group_size=group_size_hint)
     senv = cluster.initialize(cfg.backend or None, **elastic_kw)
+    # Real (post-init) group size. A wrong IMAGENT_LOCAL_DEVICES hint
+    # under --elastic means the roster was committed against the wrong
+    # group map — refuse loudly rather than shrink by the wrong stride.
+    proc_group_size = groups_lib.process_group_size(
+        cfg.model_parallel, cfg.pipeline_parallel,
+        jax.local_device_count())
+    if (cfg.elastic and senv is not None and getattr(senv, "members", ())
+            and proc_group_size != group_size_hint):
+        raise ValueError(
+            f"model-group size mismatch: the elastic rendezvous "
+            f"committed the roster assuming "
+            f"{groups_lib.LOCAL_DEVICES_ENV}="
+            f"{groups_lib.env_local_devices()} (group size "
+            f"{group_size_hint}) but this process has "
+            f"{jax.local_device_count()} local devices (group size "
+            f"{proc_group_size}); export "
+            f"{groups_lib.LOCAL_DEVICES_ENV} to the real per-process "
+            "device count in the launch wrapper")
     faultinject.configure(cfg.faults or None)
     if faultinject.active() and jax.process_index() == 0:
         print(f"FAULT DRILL: fault points armed ({cfg.faults or 'env'})",
@@ -824,6 +901,7 @@ def run(cfg: Config, stop_check=None) -> dict:
                            deadline_secs=cfg.peer_deadline_secs,
                            interval_secs=cfg.heartbeat_secs,
                            members=members,
+                           group_size=proc_group_size,
                            continue_on_death=cfg.elastic,
                            elastic_dir=(elastic_lib.elastic_dir(
                                cfg.log_dir) if cfg.elastic else None),
@@ -1032,13 +1110,34 @@ def _pod_death_exit(cfg: Config, err, pod, telem, epoch: int,
     # process id (the member's position in the sorted roster), not the
     # launched rank, because that is what decides which windows a host
     # holds.
+    # Death condemns the dead peer's whole MODEL GROUP (the verdict's
+    # "group", deadman._trip): the group's other ranks hold unusable
+    # partial replicas, so they are dead for salvage and roster
+    # purposes even while their processes still breathe. Survivors are
+    # therefore whole groups only — min(survivors) is automatically in
+    # a covering group (its ranks tile every leaf window), and the
+    # shardfmt coverage rule stays the final arbiter: no whole group
+    # surviving means the windows cannot tile, and the lander reports
+    # the honest incomplete-coverage verdict instead of committing.
     members = (list(pod.members) if pod is not None
                else list(range(jax.process_count())))
     my_rank = pod.rank if pod is not None else jax.process_index()
-    dead = {int(v["peer"])} if v.get("peer") is not None else set()
+    dead = set(int(r) for r in
+               (v.get("group")
+                or ([v["peer"]] if v.get("peer") is not None else [])))
     survivors = [r for r in members if r not in dead]
     i_land = bool(survivors) and my_rank == min(survivors)
+    i_condemned = my_rank in dead
     sharded = salvage is not None and not snapshotable(salvage["state"])
+    if i_condemned:
+        # Our own group lost a rank: our windows are exactly the ones
+        # the survivors' groups duplicate (or, with no whole group
+        # left, the ones nobody can vouch a consistent frontier for) —
+        # stay out of the salvage and let the roster exclude us.
+        salvage = None
+        print(f"DEADMAN: rank {my_rank} is in the dead peer's model "
+              f"group {sorted(dead)} — condemned with it (partial "
+              "replica); standing down from salvage", flush=True)
     if salvage is not None and (i_land or sharded):
         health_meta = (telem.health.meta_snapshot()
                        if telem.health is not None else {})
@@ -1079,9 +1178,42 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
     print(cluster.rank_banner(senv), flush=True)
     is_master = jax.process_index() == 0
 
-    mesh = cluster.make_mesh(cfg.model_parallel,
-                             pipeline_parallel=cfg.pipeline_parallel)
+    # Processes per model group, from the LIVE backend (run() already
+    # verified the pre-init IMAGENT_LOCAL_DEVICES hint agrees).
+    proc_group_size = groups_lib.process_group_size(
+        cfg.model_parallel, cfg.pipeline_parallel,
+        jax.local_device_count())
+
+    # When a model replica spans processes (proc_group_size > 1), force
+    # the naive C-order device grid: group math (death condemnation,
+    # group-aligned rosters, salvage coverage) and the group-keyed data
+    # feed below all rely on replica d being exactly the consecutive
+    # processes [d*gsize, (d+1)*gsize). mesh_utils' topology-aware
+    # permutation is only taken when replicas are process-local, where
+    # device order never crosses a failure domain.
+    mesh = cluster.make_mesh(
+        cfg.model_parallel, pipeline_parallel=cfg.pipeline_parallel,
+        devices=(jax.devices() if proc_group_size > 1 else None))
     n_data = mesh.shape[cluster.DATA_AXIS]
+    if cfg.dp and cfg.dp != n_data:
+        raise ValueError(
+            f"--dp {cfg.dp} does not match the mesh: "
+            f"{jax.device_count()} device(s) / (model_parallel "
+            f"{cfg.model_parallel} x pipeline_parallel "
+            f"{cfg.pipeline_parallel}) = data degree {n_data}. Fix the "
+            "world size or the mesh flags — silent resharding is "
+            "refused.")
+    # Model groups: processes jointly holding one replica. The world
+    # must be group-aligned (whole groups only) — under --elastic the
+    # rendezvous guarantees it, but a mis-launched static pod must be
+    # refused here before any collective.
+    if jax.process_count() % proc_group_size:
+        raise ValueError(
+            f"world size {jax.process_count()} does not divide into "
+            f"whole model groups of {proc_group_size} process(es) "
+            "(one replica spans that many ranks); launch a multiple "
+            "of the group size")
+    n_groups = jax.process_count() // proc_group_size
     if cfg.grad_accum < 1:
         raise ValueError("--grad-accum must be >= 1")
     if cfg.global_batch:
@@ -1107,7 +1239,9 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         print(f"mesh {dict(mesh.shape)} global_batch {global_batch}"
               + (f" (grad_accum {accum})" if accum > 1 else "")
               + (" [fixed --global-batch contract]"
-                 if cfg.global_batch else ""),
+                 if cfg.global_batch else "")
+              + (f" model_groups {n_groups}x{proc_group_size}"
+                 if proc_group_size > 1 else ""),
               flush=True)
 
     if len(cfg.color_jitter) != 3 or min(cfg.color_jitter) < 0.0:
@@ -1251,9 +1385,15 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
             raise ValueError("--stem s2d needs an even --image-size "
                              "(space-to-depth rearrange)")
 
+    # Data is sharded over the data axis and REPLICATED over the model
+    # axis, so the feed is keyed by model group, not by process: every
+    # process in group g loads group g's row slice (its addressable
+    # shards of the global batch — shard_batch maps local rows onto
+    # them). With process-local replicas (group size 1) this is the
+    # classic per-process slicing, unchanged.
     train_loader, val_loader = make_loaders(
-        cfg, jax.process_index(), jax.process_count(), global_batch,
-        skip_train=cfg.eval_only)
+        cfg, jax.process_index() // proc_group_size, n_groups,
+        global_batch, skip_train=cfg.eval_only)
 
     if ((cfg.fused_qkv or cfg.register_tokens)
             and not cfg.arch.startswith("vit")):
@@ -1591,12 +1731,17 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                 # lr/accum adjustment for the pod_resized telemetry
                 # event emitted once the session is up.
                 old_d = int(meta.get("device_count", 0))
+                # Pre-resize DATA degree: on a model-axis mesh it is
+                # device_count / replica size, not the device count —
+                # newer checkpoints record it; for older DP-era metas
+                # the device count IS the data degree.
+                old_dp = int(meta.get("data_parallel", 0)) or old_d
                 accum_prev = (int(meta["global_batch"])
-                              // (cfg.batch_size * old_d)
-                              if old_d and cfg.global_batch
+                              // (cfg.batch_size * old_dp)
+                              if old_dp and cfg.global_batch
                               and int(meta.get("global_batch", 0))
                               and int(meta["global_batch"])
-                              % (cfg.batch_size * old_d) == 0
+                              % (cfg.batch_size * old_dp) == 0
                               else None)
                 resized_info = {
                     "from_processes": old_p,
@@ -1651,7 +1796,11 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
     # grad-accum adjustment the fixed --global-batch contract implies.
     topo_meta = {"global_batch": global_batch,
                  "process_count": jax.process_count(), "seed": cfg.seed,
-                 "device_count": jax.device_count()}
+                 "device_count": jax.device_count(),
+                 # Data degree at save time: a model-axis resize needs
+                 # it to report the accum adjustment (devices alone
+                 # over-count by the replica size).
+                 "data_parallel": int(n_data)}
     train_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
     val_m = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
     preempted = False
@@ -1759,6 +1908,22 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
     # visible on one screen.
     launched_world = (getattr(senv, "launched_world", 0)
                       if senv is not None else 0) or jax.process_count()
+    # Mesh layout, surfaced everywhere world_size is (status.json, the
+    # status CLI, telemetry summarize, OpenMetrics): a model-axis pod
+    # degrades in whole groups, so flat rank counts alone under-read a
+    # TP/pipeline pod's health.
+    mesh_info = {
+        "dp": int(n_data),
+        "tp": int(mesh.shape[cluster.MODEL_AXIS]),
+        "pp": int(mesh.shape[cluster.PIPE_AXIS]),
+        "layout": (f"dp{int(n_data)}"
+                   f"xtp{int(mesh.shape[cluster.MODEL_AXIS])}"
+                   f"xpp{int(mesh.shape[cluster.PIPE_AXIS])}"),
+        "group_size": int(proc_group_size),
+        "groups": int(n_groups),
+        "launched_groups": max(int(launched_world) // int(proc_group_size),
+                               int(n_groups)),
+    }
     # OpenMetrics exporter (--metrics-port, telemetry/export.py):
     # process 0 serves the epoch-boundary telemetry state as a pull
     # endpoint for fleet scrapers. Module-global handle so run()'s
@@ -1769,6 +1934,9 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         "chip": jax.devices()[0].device_kind,
         "transfer_dtype": cfg.transfer_dtype,
         "launched": launched_world,
+        "mesh": mesh_info["layout"],
+        "groups": mesh_info["groups"],
+        "launched_groups": mesh_info["launched_groups"],
     }
     if cfg.metrics_port and is_master:
         exporter = export_lib.MetricsExporter(cfg.metrics_port).start()
@@ -1783,6 +1951,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         "arch": cfg.arch, "global_batch": global_batch,
         "process_count": jax.process_count(),
         "launched_process_count": launched_world,
+        "mesh": mesh_info,
         "elastic_attempt": (getattr(senv, "elastic_attempt", 0)
                             if senv is not None else 0),
         "device_count": jax.device_count(),
@@ -1844,8 +2013,12 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
             telem.gauge("hb_peer_staleness_s",
                         round(pod.max_peer_staleness(), 3))
         # Continuous pod/world_size series (elastic visibility): one
-        # float per epoch, a step down marks a shrink-to-survive.
+        # float per epoch, a step down marks a shrink-to-survive. The
+        # groups series is the model-axis twin — a TP pod that lost a
+        # replica steps down here even when stragglers keep the rank
+        # count noisy in between.
         telem.gauge("world_size", float(jax.process_count()))
+        telem.gauge("groups", float(n_groups))
         record = telem.epoch_end(ep, tm, interrupted=interrupted)
         last_input_alert[0] = (record or {}).get("input_wait_alert")
         last_clock_skew[0] = ((record or {}).get("clock")
@@ -1889,6 +2062,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                 # silently-shrunk pod must be one glance away.
                 "world_size": jax.process_count(),
                 "launched_world_size": launched_world,
+                "mesh": mesh_info,
                 # What this attempt restored (format/coverage/salvage):
                 # an incomplete-pod salvage resume stays one glance
                 # away for the whole run, not just its first print.
@@ -2394,6 +2568,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
             "degraded": bool(pod is not None and pod.degraded),
             "world_size": jax.process_count(),
             "launched_world_size": launched_world,
+            "mesh": mesh_info,
             "restored": restored_info,
             "health": (monitor.snapshot()
                        if monitor is not None else None),

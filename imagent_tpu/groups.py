@@ -1,0 +1,151 @@
+"""Model-group math for model-axis pods (tensor/pipeline meshes).
+
+A **model group** is the set of processes (launched ranks) that jointly
+hold one model replica. With ``L`` local devices per process and
+``per_replica = model_parallel x pipeline_parallel`` devices per
+replica, a replica either fits inside one process (``group size 1`` —
+the classic DP/FSDP case, and single-host TP where the model axis stays
+within-process) or spans ``per_replica / L`` consecutive processes.
+"Consecutive" is guaranteed because the engine forces the naive C-order
+device grid whenever a replica spans processes (see
+``cluster.make_mesh``): flat device ``i`` carries data index
+``i // per_replica``, process ``p`` owns devices ``[pL, (p+1)L)``, so
+replica ``d`` is exactly processes ``[d*gsize, (d+1)*gsize)``.
+
+Everything the resilience kit does per-rank in a DP pod happens
+per-GROUP in a model-axis pod:
+
+- death: one dead rank condemns its whole group (a lone survivor of a
+  TP pair holds an unusable half-replica);
+- elastic shrink/grow: the rendezvous commits group-aligned worlds only
+  (``aligned_members``) — a partial group can never join;
+- salvage: any full surviving group covers the state (its ranks tile
+  every leaf window), so the lowest survivor is automatically in a
+  covering group;
+- batch contract: accumulation re-derives from the surviving
+  data-parallel degree (``data_degree`` / ``derive_accum``).
+
+This module is pure math and deliberately jax-free (pinned by
+tests/test_groups.py) so the elastic rendezvous can use it BEFORE
+``jax.distributed.initialize`` — at that point the local device count
+comes from ``IMAGENT_LOCAL_DEVICES`` (``env_local_devices``), and the
+engine re-verifies against the real count right after init.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Pre-init hint for the per-process local device count (the elastic
+# rendezvous runs before the JAX backend exists). Launch wrappers that
+# run model-axis meshes with >1 chip per process must export it; the
+# engine refuses loudly post-init if the hint was wrong.
+LOCAL_DEVICES_ENV = "IMAGENT_LOCAL_DEVICES"
+
+
+def env_local_devices() -> int:
+    """The pre-init local-device-count hint (default 1 = one chip per
+    process, the Slurm ``--ntasks-per-node=<chips>`` convention)."""
+    raw = os.environ.get(LOCAL_DEVICES_ENV, "").strip()
+    if not raw:
+        return 1
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{LOCAL_DEVICES_ENV}={raw!r} is not an integer") from None
+    if n < 1:
+        raise ValueError(f"{LOCAL_DEVICES_ENV} must be >= 1, got {n}")
+    return n
+
+
+def process_group_size(model_parallel: int, pipeline_parallel: int = 1,
+                       local_devices: int = 1) -> int:
+    """Processes per model group: how many consecutive ranks jointly
+    hold one model replica. 1 when every replica fits in-process."""
+    mp = max(int(model_parallel), 1)
+    pp = max(int(pipeline_parallel), 1)
+    ld = int(local_devices)
+    if ld < 1:
+        raise ValueError(f"local_devices must be >= 1, got {ld}")
+    per_replica = mp * pp
+    if per_replica <= ld:
+        if ld % per_replica:
+            raise ValueError(
+                f"local device count {ld} is not a multiple of the "
+                f"replica size model_parallel x pipeline_parallel = "
+                f"{mp} x {pp} = {per_replica}: a replica would "
+                "straddle a process boundary unevenly")
+        return 1
+    if per_replica % ld:
+        raise ValueError(
+            f"replica size model_parallel x pipeline_parallel = "
+            f"{mp} x {pp} = {per_replica} is not a multiple of the "
+            f"local device count {ld}: the replica cannot span a "
+            "whole number of processes")
+    return per_replica // ld
+
+
+def group_of(rank: int, group_size: int) -> int:
+    """Model-group index of a launched rank."""
+    return int(rank) // max(int(group_size), 1)
+
+
+def group_members(rank: int, group_size: int) -> list[int]:
+    """All launched ranks in ``rank``'s model group (including it)."""
+    g = max(int(group_size), 1)
+    base = group_of(rank, g) * g
+    return list(range(base, base + g))
+
+
+def group_map(members, group_size: int) -> dict[int, list[int]]:
+    """Launched rank -> its group's launched ranks, restricted to
+    ``members`` (the committed roster). Roster commits are group-aligned
+    so in practice every group is either whole or absent."""
+    g = max(int(group_size), 1)
+    ms = sorted(int(r) for r in members)
+    return {r: [m for m in ms if m // g == r // g] for r in ms}
+
+
+def aligned_members(joiners, group_size: int) -> list[int]:
+    """The group-aligned subset of a joiner set: only ranks whose ENTIRE
+    launched group is present. This is the roster the elastic leader may
+    commit — a partial group can never join (its replica would be
+    incomplete), so its ranks stay behind as standing join requests
+    until the whole group shows up."""
+    g = max(int(group_size), 1)
+    js = sorted(int(r) for r in joiners)
+    if g == 1:
+        return js
+    seen: dict[int, int] = {}
+    for r in js:
+        seen[r // g] = seen.get(r // g, 0) + 1
+    return [r for r in js if seen[r // g] == g]
+
+
+def data_degree(n_processes: int, local_devices: int,
+                model_parallel: int, pipeline_parallel: int = 1) -> int:
+    """Data-parallel degree of a pod: total devices over replica size.
+    In a group-aligned world this always divides evenly."""
+    mp = max(int(model_parallel), 1)
+    pp = max(int(pipeline_parallel), 1)
+    total = int(n_processes) * int(local_devices)
+    per_replica = mp * pp
+    if total % per_replica:
+        raise ValueError(
+            f"device count {total} not divisible by model_parallel"
+            f"={mp} x pipeline_parallel={pp}")
+    return total // per_replica
+
+
+def derive_accum(global_batch: int, batch_size: int, n_data: int) -> int:
+    """Gradient accumulation under the fixed ``--global-batch``
+    contract at data degree ``n_data`` (the arithmetic a shrink/grow
+    re-runs — lr and the optimization batch stay fixed)."""
+    denom = int(batch_size) * int(n_data)
+    if denom <= 0 or int(global_batch) % denom:
+        raise ValueError(
+            f"--global-batch {global_batch} is not divisible by "
+            f"batch_size x data_parallel = {batch_size} x {n_data} "
+            f"= {denom}")
+    return int(global_batch) // denom

@@ -55,6 +55,7 @@ import sys
 import threading
 import time
 
+from imagent_tpu.groups import group_map  # jax-free
 from imagent_tpu.resilience import heartbeat
 from imagent_tpu.resilience import exitcodes
 from imagent_tpu.resilience.watchdog import dump_all_stacks
@@ -113,7 +114,8 @@ class DeadmanMonitor:
                  peers: list[int] | None = None,
                  continue_on_death: bool = False,
                  elastic_dir: str | None = None,
-                 elastic_attempt: int = 0):
+                 elastic_attempt: int = 0,
+                 groups: dict[int, list[int]] | None = None):
         if deadline_secs <= 0:
             raise ValueError("peer deadline must be positive")
         self.hb_dir = hb_dir
@@ -133,6 +135,12 @@ class DeadmanMonitor:
         self.continue_on_death = bool(continue_on_death)
         self._elastic_dir = elastic_dir
         self._elastic_attempt = int(elastic_attempt)
+        # Model-group map (launched rank -> its whole group's launched
+        # ranks, imagent_tpu/groups.py): a dead peer condemns every
+        # rank of its model group — the verdict carries the group so
+        # the exit ramp treats a lone TP-pair survivor as dead too.
+        self._groups = ({int(k): sorted(int(x) for x in v)
+                         for k, v in groups.items()} if groups else {})
         self.deadline = float(deadline_secs)
         self.degraded = False
         self.verdict: dict | None = None
@@ -369,6 +377,11 @@ class DeadmanMonitor:
             "t_detect": round(time.time(), 3),
             "tombstone": tombstone,
         }
+        group = self._groups.get(int(peer))
+        if group and len(group) > 1:
+            # One dead rank condemns its whole model group: the group's
+            # other ranks hold unusable partial replicas.
+            self.verdict["group"] = list(group)
         self.degraded = True
         self._escalate_at = now + self._escalate_window
         # The detection verdict on the span timeline (monitor thread):
@@ -386,11 +399,13 @@ class DeadmanMonitor:
         plan = ("continuing ELASTIC on the survivors (resize, code "
                 f"{code})" if code == exitcodes.POD_RESIZE else
                 f"exiting (code {code})")
+        gmsg = (f" — model group {self.verdict['group']} condemned "
+                "with it" if self.verdict.get("group") else "")
         print(f"DEADMAN: peer host {peer} declared dead ({reason}; "
               f"heartbeat stale {age:.1f}s, deadline "
-              f"{self.deadline:.1f}s{ts}) — pod DEGRADED: refusing new "
-              "collectives, landing the emergency snapshot, "
-              f"{plan}", file=out, flush=True)
+              f"{self.deadline:.1f}s{ts}){gmsg} — pod DEGRADED: "
+              "refusing new collectives, landing the emergency "
+              f"snapshot, {plan}", file=out, flush=True)
         dump_all_stacks(self._out)
 
     def _watch(self, poll: float) -> None:
@@ -469,10 +484,16 @@ class PodHeartbeat:
                  _exit=os._exit, members: list[int] | None = None,
                  continue_on_death: bool = False,
                  elastic_dir: str | None = None,
-                 elastic_attempt: int = 0):
+                 elastic_attempt: int = 0,
+                 group_size: int = 1):
         self.dir = heartbeat.heartbeat_dir(run_dir)
         self.rank = int(rank)
         self.world = int(world)
+        # ``group_size``: launched ranks per model group (processes
+        # jointly holding one model replica, imagent_tpu/groups.py).
+        # 1 (every DP/FSDP pod, and model axes that stay in-process)
+        # keeps the classic per-rank death semantics.
+        self.group_size = max(int(group_size), 1)
         # Elastic pod: ``rank`` is the LAUNCHED rank (the stable host
         # slot — heartbeat/tombstone identity survives re-numbering),
         # ``members`` the current roster's launched ranks (self
@@ -507,7 +528,9 @@ class PodHeartbeat:
             out=out, _exit=_exit,
             peers=[r for r in self.members if r != self.rank],
             continue_on_death=continue_on_death,
-            elastic_dir=elastic_dir, elastic_attempt=elastic_attempt)
+            elastic_dir=elastic_dir, elastic_attempt=elastic_attempt,
+            groups=(group_map(self.members, self.group_size)
+                    if self.group_size > 1 else None))
 
     def start(self) -> None:
         self.writer.start()
@@ -519,6 +542,15 @@ class PodHeartbeat:
 
     def note(self, **kw) -> None:
         self.writer.note(**kw)
+
+    def group_for(self, rank: int) -> list[int]:
+        """Launched ranks of ``rank``'s model group within the current
+        roster (``[rank]`` itself in per-rank pods)."""
+        if self.group_size <= 1:
+            return [int(rank)]
+        g = int(rank) // self.group_size
+        return ([m for m in self.members
+                 if m // self.group_size == g] or [int(rank)])
 
     @property
     def degraded(self) -> bool:

@@ -75,6 +75,14 @@ Registered fault points (grep for ``faultinject.fire``):
   stand-in). Peers must detect this via heartbeat staleness alone
   (``resilience/deadman.py``); ``code`` (default 1) sets the exit
   status, deliberately NOT a registered taxonomy code.
+* ``group.die`` (engine): ``host.die`` for a whole MODEL GROUP (the
+  ranks jointly holding one model replica, ``imagent_tpu/groups.py``)
+  — arm on every rank; each rank that shares the target ``rank``'s
+  group (default: the firing rank's own) hard-exits with ``code``
+  (default 1), tombstone-free like ``host.die``. Stands in for a
+  shared failure domain (one VM hosting a TP pair, a rack power
+  event); survivors must condemn the group via the deadman's group
+  map and salvage from a surviving WHOLE group (``make drill-tp``).
 * ``hb.stale`` (resilience/heartbeat): the heartbeat WRITER freezes
   while the process keeps running — the unobservable-host drill: peers
   must (by design) declare this host dead, because a host that cannot

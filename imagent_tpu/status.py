@@ -289,6 +289,26 @@ def render(run_dir: str, now: float | None = None,
                 f"pod: ** ELASTIC RESIZED — running on {world} of "
                 f"{launched} launched host(s) ** (grad-accum absorbs "
                 "the difference under the --global-batch contract)")
+        mesh = st.get("mesh")
+        if mesh and int(mesh.get("group_size", 1) or 1) > 1:
+            # Model-axis pods degrade in whole groups, not flat ranks:
+            # render the mesh layout and the group count so a TP pod
+            # that lost a replica reads as such, not as "N hosts".
+            groups = int(mesh.get("groups", 0) or 0)
+            launched_g = int(mesh.get("launched_groups", groups)
+                             or groups)
+            line = (f"mesh: {mesh.get('layout')} — {groups} model "
+                    f"group(s) of {mesh.get('group_size')} host(s)")
+            if launched_g > groups:
+                line += (f"  ** {launched_g - groups} group(s) "
+                         "DEGRADED (lost whole groups; accum absorbs "
+                         "the lost data degree) **")
+            lines.append(line)
+        elif mesh and (int(mesh.get("tp", 1) or 1) > 1
+                       or int(mesh.get("pp", 1) or 1) > 1):
+            # In-process model axes: still worth a glance (dp is not
+            # the device count), but groups are per-host here.
+            lines.append(f"mesh: {mesh.get('layout')}")
         restored = st.get("restored")
         if restored:
             # What THIS attempt resumed from: format, shard coverage,

@@ -132,6 +132,17 @@ def summarize(run_dir: str, ckpt_dir: str | None = None) -> str:
             f"{run_start.get('global_batch', '?')} x"
             f"{run_start.get('process_count', '?')} host(s), "
             f"{run_start.get('steps_per_epoch', '?')} steps/epoch")
+        mesh = run_start.get("mesh")
+        if isinstance(mesh, dict) and (int(mesh.get("tp", 1) or 1) > 1
+                                       or int(mesh.get("pp", 1) or 1)
+                                       > 1):
+            # Model-axis runs: the flat host count above under-reads
+            # the pod — add the mesh layout and the group structure
+            # (a NEW line, so the DP golden table stays byte-identical).
+            lines.append(
+                f"  mesh: {mesh.get('layout')} — "
+                f"{mesh.get('groups', '?')} model group(s) of "
+                f"{mesh.get('group_size', '?')} host(s)")
         restored = run_start.get("restored")
         if isinstance(restored, dict):
             # The sharded-resilience surfacing: which generation this

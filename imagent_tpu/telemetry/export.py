@@ -156,9 +156,15 @@ def render_state(state: dict | None, now: float | None = None) -> str:
     info = exp.family("imagent_run_info", "gauge",
                       "run identity (labels; value is always 1)")
     if run:
-        info.sample(1, arch=str(run.get("arch", "?")),
-                    chip=str(run.get("chip", "?")),
-                    transfer_dtype=str(run.get("transfer_dtype", "?")))
+        labels = dict(arch=str(run.get("arch", "?")),
+                      chip=str(run.get("chip", "?")),
+                      transfer_dtype=str(run.get("transfer_dtype", "?")))
+        if run.get("mesh"):
+            # Model-axis runs carry the mesh layout as an identity
+            # label (dpAxtpBxppC) — scrapers slice fleet dashboards by
+            # parallelism shape without a schema bump.
+            labels["mesh"] = str(run.get("mesh"))
+        info.sample(1, **labels)
     exp.family("imagent_up", "gauge",
                "1 while the training process serves this endpoint"
                ).sample(1)
@@ -219,6 +225,18 @@ def render_state(state: dict | None, now: float | None = None) -> str:
                    "processes the scheduler launched (a gap vs "
                    "world_size = elastic resize)"
                    ).sample(run.get("launched"))
+        if run.get("groups") is not None:
+            # Model-axis twin of world_size: a TP/pipeline pod loses
+            # capacity in whole model groups, so fleet alerts key on
+            # this pair, not the flat rank count.
+            exp.family("imagent_pod_groups", "gauge",
+                       "model groups in the pod (sets of ranks "
+                       "jointly holding one model replica)"
+                       ).sample(run.get("groups"))
+            exp.family("imagent_pod_launched_groups", "gauge",
+                       "model groups the scheduler launched (a gap "
+                       "vs groups = whole-group loss)"
+                       ).sample(run.get("launched_groups"))
         exp.family("imagent_pod_stragglers", "gauge",
                    "hosts flagged as stragglers last epoch"
                    ).sample(len(record.get("stragglers") or []))

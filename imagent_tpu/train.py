@@ -310,8 +310,49 @@ def _nonfinite_local(gnorm2, metrics) -> jnp.ndarray:
                            & jnp.all(jnp.isfinite(metrics)))
 
 
-def _health_stats(gnorm2, params, new_params, reduce_axes=None
-                  ) -> jnp.ndarray:
+def _sq_sum_normalized(tree, overcount) -> jnp.ndarray:
+    """``_sq_sum`` with each leaf's square-sum divided by its
+    replication factor over the health psum axes (``overcount``, a
+    matching tree of static fp32 scalars from ``_health_overcounts``):
+    the subsequent psum then yields the EXACT global square-sum for
+    replicated and sharded leaves alike."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    factors = jax.tree_util.tree_leaves(overcount)
+    return sum((jnp.sum(jnp.square(g.astype(jnp.float32))) / f
+                for g, f in zip(leaves, factors)), jnp.float32(0.0))
+
+
+def _health_overcounts(param_specs, mesh, axes):
+    """Per-leaf replication factor of the health square-sums over the
+    psum ``axes``: the product of the sizes of every axis the leaf's
+    PartitionSpec does NOT name (a replicated copy per shard). Sharded
+    leaves get 1.0 — their windows already sum to the global value.
+    Static fp32 constants, closed over by the step (no runtime cost
+    beyond one scalar divide per leaf)."""
+    sizes = {a: int(mesh.shape[a]) for a in axes}
+
+    def factor(spec):
+        named: set = set()
+        if spec is not None:
+            for entry in spec:
+                if entry is None:
+                    continue
+                if isinstance(entry, (tuple, list)):
+                    named.update(entry)
+                else:
+                    named.add(entry)
+        f = 1.0
+        for a, s in sizes.items():
+            if a not in named:
+                f *= s
+        return jnp.float32(f)
+
+    return jax.tree.map(factor, param_specs,
+                        is_leaf=lambda x: x is None or isinstance(x, P))
+
+
+def _health_stats(gnorm2, params, new_params, reduce_axes=None,
+                  overcount=None) -> jnp.ndarray:
     """``[grad_norm, param_norm, update_ratio]`` (``HEALTH_FIELDS``)
     computed in-graph from square-sums the step already holds — the
     model-health tail of the replicated metric vector. No host sync:
@@ -321,19 +362,30 @@ def _health_stats(gnorm2, params, new_params, reduce_axes=None
     sums are ``psum``-ed over the model/pipe axes so sharded leaves
     contribute exactly once. On the pure data-parallel path both axes
     are size 1 and the psum is the identity (norms exact). In
-    model-parallel configs a leaf REPLICATED over a reduce axis is
-    counted axis-size times — a constant inflation that cancels in the
-    EWMA-relative detection (and cancels exactly in update_ratio,
-    whose numerator and denominator inflate together).
+    model-parallel configs a leaf REPLICATED over a reduce axis would
+    be counted axis-size times; ``overcount`` (the per-leaf factor
+    tree from ``_health_overcounts``, derived from the state's
+    PartitionSpecs) divides that inflation out BEFORE the psum, so the
+    series read identically across DP and TP runs — EWMAs, spike
+    detection, status.json, and the OpenMetrics gauges see the same
+    numbers either way. ``gnorm2`` must already be normalized by the
+    caller when ``overcount`` is set (it is shared with the non-finite
+    guard, which needs the raw un-normalized scalar).
 
     Non-finite inputs are passed through untouched: on a guarded-out
     step the norms carry the explosion's magnitude (or its NaN) to the
     flight recorder, while the host keys the skip on n == 0 as always.
     """
-    pnorm2 = _sq_sum(params)
-    dnorm2 = _sq_sum(jax.tree.map(
-        lambda new, old: new.astype(jnp.float32)
-        - old.astype(jnp.float32), new_params, params))
+    if overcount is None:
+        pnorm2 = _sq_sum(params)
+        dnorm2 = _sq_sum(jax.tree.map(
+            lambda new, old: new.astype(jnp.float32)
+            - old.astype(jnp.float32), new_params, params))
+    else:
+        pnorm2 = _sq_sum_normalized(params, overcount)
+        dnorm2 = _sq_sum_normalized(jax.tree.map(
+            lambda new, old: new.astype(jnp.float32)
+            - old.astype(jnp.float32), new_params, params), overcount)
     if reduce_axes is not None:
         gnorm2 = lax.psum(gnorm2, reduce_axes)
         pnorm2 = lax.psum(pnorm2, reduce_axes)
@@ -487,6 +539,18 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     loss_fn = make_loss_fn(model, label_smoothing, aux_loss_weight)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     prep = make_input_prep(mean, std, jitter_fn)
+    # Health-norm replication factors over the (pipe, model) psum axes,
+    # from the params' PartitionSpecs: with a real model axis a
+    # replicated leaf would otherwise be counted axis-size times in
+    # grad/param norms, making a TP run's health series read ~sqrt(tp)x
+    # a DP run's. None on the pure-DP path (both axes size 1 — exact
+    # already) so its compiled graph is untouched.
+    health_overcount = None
+    if (health_stats and state_specs is not None
+            and int(mesh.shape[PIPE_AXIS]) * int(mesh.shape[MODEL_AXIS])
+            > 1):
+        health_overcount = _health_overcounts(
+            state_specs.params, mesh, (PIPE_AXIS, MODEL_AXIS))
 
     def accumulate(params, batch_stats, images, labels):
         """(grads_mean, metrics_sum, new_batch_stats) over K micro-batches."""
@@ -574,10 +638,18 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
             # explosion's magnitude to the flight recorder; the n == 0
             # head still tells the host the update never applied).
             # Post-pmean grads and replicated params are identical on
-            # every data shard, so only model/pipe need reducing.
+            # every data shard, so only model/pipe need reducing. With
+            # a real model axis the grad square-sum is recomputed
+            # per-leaf with the replication factors divided out (the
+            # guard above needs the raw gnorm2, so it can't be shared
+            # here) — DP/TP health parity is pinned by
+            # tests/test_tp_pod.py.
             metrics = jnp.concatenate([metrics, _health_stats(
-                gnorm2, state.params, new_params,
-                reduce_axes=(PIPE_AXIS, MODEL_AXIS))])
+                (gnorm2 if health_overcount is None
+                 else _sq_sum_normalized(grads, health_overcount)),
+                state.params, new_params,
+                reduce_axes=(PIPE_AXIS, MODEL_AXIS),
+                overcount=health_overcount)])
 
         new_ema = state.ema_params
         new_ema_bs = state.ema_batch_stats

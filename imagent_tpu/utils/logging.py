@@ -165,6 +165,11 @@ class TrainLogger:
             # with the pod/resized marker and the status CLI line.
             w.add_scalar("pod/world_size", counters["world_size"],
                          epoch)
+        if "groups" in counters:
+            # Model-axis twin: a TP/pipeline pod degrades in whole
+            # model groups, so this series steps down on a replica
+            # loss even when the rank count alone reads noisy.
+            w.add_scalar("pod/groups", counters["groups"], epoch)
         w.flush()
 
     def slo_breach(self, epoch: int, objective: str) -> None:

@@ -1,0 +1,98 @@
+"""Model-parallel pod acceptance drill worker (ISSUE 16 — REAL OS
+processes through the REAL CLI, so the group-aware resize path is
+exactly what production runs). Phases via ``IMAGENT_TP_PHASE``:
+
+``kill`` (the acceptance bar): a 4-process pod runs ``--tp 2`` through
+production ``engine.run`` — mesh (data=2, pipe=1, model=2), TWO model
+groups {0,1} and {2,3}, the fixed ``--global-batch 12`` contract
+(batch 1 x data degree 2 x accum 6). ``group.die:after=3;rank=2`` is
+armed on EVERY rank (the registry contract): at step 3 only the ranks
+sharing rank 2's model group — ranks 2 AND 3 — hard-exit, tombstone-
+free, while the survivors' ``stall-step`` holds them out of the next
+collective. Each survivor's deadman must condemn the WHOLE group (the
+verdict carries ``group [2, 3]``), the lowest survivor (rank 0, in the
+surviving whole group {0,1}, which covers every sharded leaf window)
+must land the sharded emergency salvage, and both survivors must
+exec-restart into the group-aligned rendezvous, re-form a ONE-group
+world (``pod_resized`` 4→2 processes, accum 6→12, lr unchanged —
+the surviving data degree re-derives the accumulation), reshard the
+salvage onto the smaller mesh, finish the epoch, and exit 0.
+
+``resume``: a fresh 4-process pod (the replacement group arrived)
+restores the 2-process checkpoint back onto TWO groups
+(``pod_resized`` 2→4, accum 12→6) and trains epoch 1 to completion.
+
+``reference``: the uninterrupted ``--tp 2`` run the drill's loss is
+compared against (same seed/contract, epochs via IMAGENT_TP_EPOCHS).
+
+Sample traces are written per LAUNCHED rank (``trace_r<rank>``): the
+group-keyed feed gives both members of a group the same loader stream
+(process index = group index), so same-prefix concurrent writers would
+collide; the parent dedups by group instead.
+
+Usage: python mp_worker_tp_pod.py <rank> <port> <world>
+(scratch via IMAGENT_MP_SCRATCH).
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    world = int(sys.argv[3])
+    scratch = os.environ["IMAGENT_MP_SCRATCH"]
+    phase = os.environ.get("IMAGENT_TP_PHASE", "kill")
+    epochs = os.environ.get("IMAGENT_TP_EPOCHS", "1")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1")
+    os.environ.update({
+        "SLURM_JOB_NUM_NODES": str(world),
+        "SLURM_NODEID": str(rank),
+        "SLURM_LOCALID": "0",
+        "SLURM_PROCID": str(rank),
+        "SLURM_NTASKS": str(world),
+        "SLURM_JOB_NODELIST": "127.0.0.1",
+        "IMAGENT_COORDINATOR_PORT": str(port),
+        "IMAGENT_HOST_ADDR": "127.0.0.1",
+        # One chip per process: the pre-init group-size hint the
+        # rendezvous uses (a --tp 2 replica then spans 2 ranks).
+        "IMAGENT_LOCAL_DEVICES": "1",
+        "IMAGENT_DEADMAN_ESCALATE_SECS": "12",
+    })
+    # Per-LAUNCHED-rank trace prefix: group partners share a loader
+    # process index, so a shared prefix would interleave writers.
+    os.environ["IMAGENT_SAMPLE_TRACE"] = os.path.join(
+        scratch, f"trace_r{rank}")
+    if phase == "kill":
+        # group.die armed on EVERY rank; only rank 2's model group
+        # ({2, 3}) dies. The survivors additionally stall past the 2s
+        # deadline so the salvage frontier is exactly steps [0, 3).
+        faults = "group.die:after=3;rank=2"
+        if rank in (0, 1):
+            faults += ",stall-step:after=3;secs=6"
+        os.environ["IMAGENT_FAULTS"] = faults
+
+    argv = [
+        "--backend", "cpu", "--arch", "vit_debug", "--image-size", "16",
+        "--num-classes", "4", "--dataset", "synthetic",
+        "--synthetic-size", "96", "--batch-size", "1",
+        "--tp", "2",
+        "--elastic", "--global-batch", "12",
+        "--elastic-settle-secs", "4",
+        "--workers", "0", "--no-bf16", "--log-every", "0",
+        "--seed", "0", "--save-model", "--eval-every", "5",
+        "--epochs", epochs, "--lr", "0.05",
+        "--peer-deadline-secs", "2.0", "--heartbeat-secs", "0.25",
+        "--watchdog-secs", "120",
+        "--log-dir", os.path.join(scratch, "tb"),
+        "--ckpt-dir", os.path.join(scratch, "ck"),
+    ]
+    from imagent_tpu.__main__ import main as cli_main
+    return cli_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -422,20 +422,35 @@ def test_elastic_requires_global_batch(tmp_path):
 
 
 def test_elastic_refuses_model_axis_paths(tmp_path):
-    """The sharded-snapshot work made --fsdp/--zero1 legal under
-    --elastic (their shards reshard onto the resized mesh at restore);
-    model-axis meshes still cannot resize — a host loss changes the
-    mesh shape itself."""
+    """The group-aware work (ISSUE 16) made --tp/--pp legal under
+    --elastic (a dead rank condemns its whole model group, survivors
+    shrink by whole groups, sharded snapshots reshard); seq-parallel
+    and expert-parallel STAY refused — their token/expert routing
+    re-partitions activation state across the model axis and no
+    group-aligned salvage covers it. The refusal must name that real
+    remaining constraint, not the pre-PR-14 'data-parallel family'."""
     from imagent_tpu.engine import run
-    with pytest.raises(ValueError, match="data-parallel family"):
+    with pytest.raises(ValueError, match="seq-parallel and "
+                                         "expert-parallel stay refused"):
         run(_engine_cfg(tmp_path, elastic=True, global_batch=16,
-                        model_parallel=2, tensor_parallel=True))
-    with pytest.raises(ValueError, match="data-parallel family"):
+                        arch="vit_b16", seq_parallel="ring",
+                        model_parallel=2))
+    with pytest.raises(ValueError, match="seq-parallel and "
+                                         "expert-parallel stay refused"):
         run(_engine_cfg(tmp_path, elastic=True, global_batch=16,
-                        pipeline_parallel=2))
-    # fsdp/zero1 now pass the elastic gate: these configs fail LATER,
-    # at the global-batch divisibility check — proof the elastic
-    # validation no longer rejects them.
+                        arch="vit_b16", moe_every=1, num_experts=4,
+                        expert_parallel=True, model_parallel=2))
+    # tp/pp now pass the elastic gate: these configs fail LATER, at
+    # the global-batch divisibility check (8 devices / tp 2 = data
+    # degree 4; 18 % 4 != 0) — proof the elastic validation no longer
+    # rejects the tensor/pipeline meshes themselves.
+    with pytest.raises(ValueError, match="not divisible"):
+        run(_engine_cfg(tmp_path, elastic=True, global_batch=18,
+                        arch="vit_debug", tp=2))
+    with pytest.raises(ValueError, match="not divisible"):
+        run(_engine_cfg(tmp_path, elastic=True, global_batch=18,
+                        arch="vit_debug", pp=2, microbatches=2))
+    # fsdp/zero1 likewise (legal since the sharded-snapshot work).
     with pytest.raises(ValueError, match="not divisible"):
         run(_engine_cfg(tmp_path, elastic=True, global_batch=18,
                         fsdp=True))

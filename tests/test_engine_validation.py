@@ -60,6 +60,27 @@ def _cfg(**kw):
      "--decode-offload applies to the imagefolder/tar"),
     (dict(dataset="imagefolder", decode_offload="nonsense"),
      "not host:port"),
+    # Mesh-axis shorthands (ISSUE 16): one spelling, sane degrees.
+    (dict(tp=-1), "--tp/--pp/--dp must be >= 0"),
+    (dict(arch="vit_debug", tp=1), "--tp must be >= 2"),
+    (dict(arch="vit_debug", pp=1), "--pp must be >= 2"),
+    (dict(arch="vit_debug", tp=2, tensor_parallel=True,
+          model_parallel=2), "one spelling, not both"),
+    (dict(arch="vit_debug", tp=2, model_parallel=2),
+     "one spelling, not both"),
+    (dict(arch="vit_debug", pp=2, pipeline_parallel=2),
+     "one spelling, not both"),
+    # 8 fake devices (conftest): a 3-wide model axis cannot tile them.
+    (dict(arch="vit_debug", tp=3), "not a multiple of the replica"),
+    # --dp is a CHECK, not a knob: 8 devices / tp 2 = data degree 4.
+    (dict(arch="vit_debug", tp=2, dp=3), "--dp 3 does not match"),
+    # Model-axis meshes shard leaves; the legacy Orbax path has no
+    # sharded save/restore or salvage coverage rule.
+    (dict(arch="vit_debug", tp=2, ckpt_format="orbax"),
+     "orbax does not cover model-axis meshes"),
+    (dict(arch="vit_debug", pp=2, microbatches=2,
+          ckpt_format="orbax"),
+     "orbax does not cover model-axis meshes"),
 ])
 def test_invalid_combinations_rejected(kw, match):
     with pytest.raises(ValueError, match=match):
@@ -92,6 +113,9 @@ def test_moe_pp_ep_reachable_from_cli(tmp_path):
     dict(moe_every=1, num_experts=4, moe_groups=1),
     dict(moe_every=1, num_experts=4, expert_parallel=True,
          model_parallel=2),
+    dict(tp=2),                    # ISSUE 16 shorthand spellings
+    dict(pp=2, microbatches=2),
+    dict(tp=2, pp=2, microbatches=2),
 ])
 def test_every_parallelism_flag_runs_from_cli(kw, tmp_path):
     """Each strategy the README advertises must work end-to-end from the

@@ -1,0 +1,272 @@
+"""Model-group math and group-aware resilience plumbing (ISSUE 16).
+
+A **model group** is the set of launched ranks jointly holding one model
+replica (``imagent_tpu/groups.py``). Layers under test, cheapest first:
+
+* the pure rank->group arithmetic: group size from (mp, pp, local
+  devices), membership, the group-aligned subset of a joiner set, data
+  degree and the fixed-``--global-batch`` accumulation re-derivation a
+  shrink/grow re-runs;
+* the module's jax-free contract (it runs inside the pre-init
+  rendezvous, same bar as elastic/heartbeat);
+* the elastic rendezvous with ``group_size`` > 1: group-aligned worlds
+  commit, a PARTIAL group never does (the leader waits), a launched
+  world that does not divide into whole groups is refused upfront;
+* the deadman's group condemnation: one dead rank's verdict carries its
+  whole model group.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from imagent_tpu import elastic, groups
+from imagent_tpu.resilience import heartbeat
+from imagent_tpu.resilience.deadman import DeadmanMonitor
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+
+
+# ---------------------------------------------------------------------------
+# Pure math
+# ---------------------------------------------------------------------------
+
+
+def test_groups_module_is_jax_free():
+    """groups.py feeds the pre-init rendezvous; it must never import
+    the JAX runtime (same contract as elastic/heartbeat/deadman)."""
+    src = open(os.path.join(_REPO, "imagent_tpu", "groups.py")).read()
+    assert "import jax" not in src
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; import imagent_tpu.groups; "
+         "sys.exit(1 if any(m == 'jax' or m.startswith('jax.') "
+         "for m in sys.modules) else 0)"],
+        cwd=_REPO, capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+
+
+@pytest.mark.parametrize("mp,pp,ld,expect", [
+    (1, 1, 1, 1),    # plain DP
+    (2, 1, 1, 2),    # TP pair spanning 2 one-chip processes
+    (1, 2, 1, 2),    # pipeline stage pair
+    (2, 2, 1, 4),    # TP x PP block of 4 processes
+    (4, 1, 2, 2),    # replica of 4 over 2-chip processes
+    (2, 1, 2, 1),    # replica fits inside one 2-chip process
+    (2, 2, 4, 1),    # replica == the process: classic single-host TP
+    (1, 1, 8, 1),    # 8-chip DP process (the test session's shape)
+])
+def test_process_group_size(mp, pp, ld, expect):
+    assert groups.process_group_size(mp, pp, ld) == expect
+
+
+def test_process_group_size_refuses_straddling_replicas():
+    # Replica does not divide the process: would straddle unevenly.
+    with pytest.raises(ValueError, match="straddle"):
+        groups.process_group_size(3, 1, 4)
+    # Replica larger than a process but not a whole number of them.
+    with pytest.raises(ValueError, match="whole number of processes"):
+        groups.process_group_size(3, 1, 2)
+    with pytest.raises(ValueError, match=">= 1"):
+        groups.process_group_size(2, 1, 0)
+
+
+def test_group_membership():
+    assert [groups.group_of(r, 2) for r in range(6)] == \
+        [0, 0, 1, 1, 2, 2]
+    assert groups.group_members(5, 2) == [4, 5]
+    assert groups.group_members(5, 1) == [5]
+    assert groups.group_members(5, 3) == [3, 4, 5]
+    # group_map restricted to a committed roster.
+    assert groups.group_map([0, 1, 2, 3], 2) == \
+        {0: [0, 1], 1: [0, 1], 2: [2, 3], 3: [2, 3]}
+    assert groups.group_map([2, 3], 2) == {2: [2, 3], 3: [2, 3]}
+
+
+def test_aligned_members():
+    # group_size 1: everything aligns (the DP fast path).
+    assert groups.aligned_members([3, 0, 2], 1) == [0, 2, 3]
+    # Only whole groups survive the filter; order is sorted.
+    assert groups.aligned_members([0, 1, 3], 2) == [0, 1]
+    assert groups.aligned_members([3, 2, 1], 2) == [2, 3]
+    assert groups.aligned_members([1, 3], 2) == []
+    assert groups.aligned_members([0, 1, 2, 3, 4, 5], 3) == \
+        [0, 1, 2, 3, 4, 5]
+    assert groups.aligned_members([0, 1, 2, 4, 5], 3) == [0, 1, 2]
+
+
+def test_data_degree_and_accum_rederivation():
+    """The shrink-by-group arithmetic under the fixed --global-batch
+    contract: losing a whole TP group halves the data degree and the
+    accumulation absorbs it exactly (lr untouched by construction)."""
+    # 4 one-chip processes, --tp 2: dp 2.
+    assert groups.data_degree(4, 1, 2) == 2
+    assert groups.derive_accum(12, 1, 2) == 6
+    # One group dies -> 2 processes: dp 1, accum doubles.
+    assert groups.data_degree(2, 1, 2) == 1
+    assert groups.derive_accum(12, 1, 1) == 12
+    # TP x PP block over 8 ranks.
+    assert groups.data_degree(8, 1, 2, 2) == 2
+    # Non-group-aligned worlds are arithmetic errors, loudly.
+    with pytest.raises(ValueError, match="not divisible"):
+        groups.data_degree(3, 1, 2)
+    with pytest.raises(ValueError, match="not divisible"):
+        groups.derive_accum(12, 5, 2)
+
+
+def test_env_local_devices(monkeypatch):
+    monkeypatch.delenv(groups.LOCAL_DEVICES_ENV, raising=False)
+    assert groups.env_local_devices() == 1
+    monkeypatch.setenv(groups.LOCAL_DEVICES_ENV, "4")
+    assert groups.env_local_devices() == 4
+    monkeypatch.setenv(groups.LOCAL_DEVICES_ENV, "zero")
+    with pytest.raises(ValueError, match="not an integer"):
+        groups.env_local_devices()
+    monkeypatch.setenv(groups.LOCAL_DEVICES_ENV, "0")
+    with pytest.raises(ValueError, match=">= 1"):
+        groups.env_local_devices()
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous: group-aligned commits only
+# ---------------------------------------------------------------------------
+
+
+def _join_all(edir, ranks, world, results, **kw):
+    ts = []
+    for r in ranks:
+        def run(rank=r):
+            try:
+                results[rank] = elastic.rendezvous(
+                    edir, rank, world, 29500, settle_secs=0.6,
+                    host="127.0.0.1", out=lambda m: None, **kw)
+            except Exception as e:
+                results[rank] = e
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        ts.append(t)
+    for t in ts:
+        t.join(25)
+    return results
+
+
+def test_rendezvous_refuses_unaligned_launched_world(tmp_path):
+    with pytest.raises(ValueError, match="whole model groups"):
+        elastic.rendezvous(str(tmp_path), 0, 5, 29500, group_size=2,
+                           settle_secs=0.1, out=lambda m: None)
+
+
+def test_rendezvous_commits_group_aligned_worlds_only(tmp_path):
+    edir = str(tmp_path / "elastic")
+    # Full 4-rank world, groups of 2: commits immediately.
+    rs = _join_all(edir, range(4), 4, {}, group_size=2)
+    assert all(rs[r]["members"] == [0, 1, 2, 3] for r in range(4)), rs
+    # Rank 2 lost its partner (rank 3 never joins): the committed
+    # roster is the surviving WHOLE group only — the orphaned half
+    # replica is excluded, never half-joined.
+    from imagent_tpu.resilience import exitcodes
+    rs2 = _join_all(edir, (0, 1, 2), 4, {}, group_size=2,
+                    patience_secs=3.0)
+    assert rs2[0]["members"] == [0, 1], rs2
+    assert rs2[1]["members"] == [0, 1]
+    assert isinstance(rs2[2], exitcodes.ElasticExcludedError), rs2
+    live = elastic.read_roster(edir)
+    assert live["members"] == [0, 1]
+    assert live["world"] == 2
+
+
+def test_rendezvous_partial_group_never_commits_alone(tmp_path):
+    """Two orphaned half-groups (ranks 1 and 2 from different groups):
+    no group-aligned subset exists, so NO roster is ever published —
+    both give up excluded rather than form a broken half-replica pod."""
+    edir = str(tmp_path / "elastic")
+    from imagent_tpu.resilience import exitcodes
+    rs = _join_all(edir, (1, 2), 4, {}, group_size=2,
+                   patience_secs=2.5)
+    assert isinstance(rs[1], exitcodes.ElasticExcludedError), rs
+    assert isinstance(rs[2], exitcodes.ElasticExcludedError), rs
+    assert elastic.read_roster(edir) is None
+
+
+# ---------------------------------------------------------------------------
+# Deadman: one dead rank condemns its whole model group
+# ---------------------------------------------------------------------------
+
+
+def _beat(hb_dir, rank, seq):
+    heartbeat._write_atomic(
+        heartbeat.heartbeat_path(hb_dir, rank),
+        {"rank": rank, "pid": 1234, "seq": seq, "t": time.time(),
+         "epoch": 0, "step": seq, "phase": "train"})
+
+
+def test_deadman_verdict_condemns_whole_group(tmp_path):
+    """Rank 2 goes silent in a 4-rank pod with groups {0,1} and {2,3}:
+    the verdict names peer 2 AND carries group [2, 3] — survivors must
+    treat rank 3 as dead too (its half replica is unusable) and shrink
+    by the whole group."""
+    hb = str(tmp_path)
+    gmap = groups.group_map([0, 1, 2, 3], 2)
+    m = DeadmanMonitor(hb, rank=0, world=4, deadline_secs=0.4,
+                       escalate_secs=60.0, _exit=lambda c: None,
+                       peers=[1, 2, 3], continue_on_death=True,
+                       groups=gmap)
+    for seq in range(3):
+        for r in (1, 2, 3):
+            _beat(hb, r, seq)
+        time.sleep(0.1)
+    m.start()
+    try:
+        deadline = time.time() + 5.0
+        while not m.degraded and time.time() < deadline:
+            seq = int(time.time() * 10) % 100000
+            _beat(hb, 1, seq)  # my partner stays up
+            _beat(hb, 3, seq)  # the dead rank's partner stays up too
+            time.sleep(0.05)
+        assert m.degraded
+        assert m.verdict["peer"] == 2
+        assert m.verdict["group"] == [2, 3]
+    finally:
+        m.stop()
+
+
+def test_deadman_no_group_entry_for_singleton_groups(tmp_path):
+    """group_size 1 (or a group map of singletons): the verdict stays
+    exactly the PR 13 shape — no ``group`` key, nothing downstream
+    changes for DP pods."""
+    hb = str(tmp_path)
+    m = DeadmanMonitor(hb, rank=0, world=2, deadline_secs=0.4,
+                       escalate_secs=60.0, _exit=lambda c: None,
+                       peers=[1], continue_on_death=True,
+                       groups=groups.group_map([0, 1], 1))
+    _beat(hb, 1, 0)
+    time.sleep(0.1)
+    m.start()
+    try:
+        deadline = time.time() + 5.0
+        while not m.degraded and time.time() < deadline:
+            time.sleep(0.05)
+        assert m.degraded
+        assert m.verdict["peer"] == 1
+        assert "group" not in m.verdict
+    finally:
+        m.stop()
+
+
+def test_pod_heartbeat_group_for():
+    """PodHeartbeat.group_for answers from the CURRENT roster: a group
+    that already lost a member reports only the surviving ranks."""
+    from imagent_tpu.resilience.deadman import PodHeartbeat
+    ph = PodHeartbeat.__new__(PodHeartbeat)
+    ph.group_size = 2
+    ph.members = [0, 1, 2]
+    assert ph.group_for(0) == [0, 1]
+    assert ph.group_for(2) == [2]
+    assert ph.group_for(3) == [2]  # 3 itself absent from the roster
+    ph.group_size = 1
+    assert ph.group_for(2) == [2]

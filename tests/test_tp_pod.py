@@ -1,0 +1,321 @@
+"""Model-parallel production runs (ISSUE 16): group-aware elasticity,
+death detection, and salvage for TP/pipeline meshes.
+
+Layers under test, cheapest first:
+
+* health-series parity: a TP-sharded run's grad-norm / param-norm /
+  update-ratio must read IDENTICALLY to the equivalent DP run — the
+  per-leaf replica-overcount normalization (``train._health_overcounts``)
+  makes EWMAs, spike detection, and the OpenMetrics gauges mesh-
+  agnostic;
+* production ``--tp`` through ``engine.run`` on one process: the mesh
+  layout is surfaced in ``status.json``, the status CLI, ``telemetry
+  summarize``, and the run_start record;
+* THE acceptance drill (real OS processes through the real CLI,
+  ``tests/mp_worker_tp_pod.py``, ``make drill-tp``): a 4-process
+  ``--tp 2`` pod — two model groups — loses a whole group mid-epoch
+  via ``group.die``; the survivors condemn the GROUP (not just the
+  silent rank), salvage from the surviving whole group, exec-restart
+  into a group-aligned one-group world (accum re-derived under the
+  fixed ``--global-batch``), finish; a fresh 4-process resume
+  re-expands to two groups; the final loss matches the uninterrupted
+  run within 1% and no sample is replayed or skipped.
+"""
+
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from imagent_tpu.data.stream import StreamKey, open_stream
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+
+
+# ---------------------------------------------------------------------------
+# Health parity: TP norms must read like DP norms (the normalization)
+# ---------------------------------------------------------------------------
+
+_TINY = dict(patch_size=8, hidden_dim=32, num_layers=2, num_heads=4,
+             mlp_dim=64, num_classes=8)
+_SIZE = 32
+
+
+def _health_series(model_parallel: int, steps: int = 3):
+    """Run ``steps`` chained train steps with health_stats on the given
+    mesh; return the (steps, 3) array of HEALTH_FIELDS."""
+    import jax
+    from imagent_tpu.cluster import MODEL_AXIS, make_mesh
+    from imagent_tpu.models.vit import VisionTransformer
+    from imagent_tpu.parallel.tensor_parallel import vit_tp_param_specs
+    from imagent_tpu.train import (
+        create_train_state, make_optimizer, make_train_step, place_state,
+        replicate_state, shard_batch, state_partition_specs,
+    )
+
+    mesh = make_mesh(model_parallel=model_parallel)
+    opt = make_optimizer()
+    init_model = VisionTransformer(**_TINY)
+    state = create_train_state(init_model, jax.random.key(0), _SIZE, opt)
+    if model_parallel > 1:
+        model = VisionTransformer(**_TINY, tp_axis=MODEL_AXIS)
+        specs = state_partition_specs(
+            state, vit_tp_param_specs(state.params))
+        state = place_state(state, mesh, specs)
+        step = make_train_step(model, opt, mesh, state_specs=specs,
+                               health_stats=True)
+    else:
+        state = replicate_state(state, mesh)
+        step = make_train_step(init_model, opt, mesh, health_stats=True)
+
+    rng = np.random.default_rng(0)
+    out = []
+    for i in range(steps):
+        images = rng.normal(size=(16, _SIZE, _SIZE, 3)).astype(np.float32)
+        labels = rng.integers(0, 8, size=(16,)).astype(np.int32)
+        gi, gl = shard_batch(mesh, images, labels)
+        state, metrics = step(state, gi, gl, np.float32(0.1))
+        out.append(np.asarray(metrics)[4:7])
+    return np.stack(out)
+
+
+def test_tp_health_series_matches_dp():
+    """The documented replica-overcount: a leaf replicated over the
+    model axis would contribute axis-size times to the health psum.
+    The per-leaf normalization divides the inflation out, so a --tp 2
+    (and --tp 4) run's grad/param/update-ratio series equal the plain
+    DP run's — byte-comparable dashboards across mesh shapes."""
+    dp = _health_series(1)
+    for mp in (2, 4):
+        tp = _health_series(mp)
+        np.testing.assert_allclose(tp, dp, rtol=2e-4, atol=1e-5,
+                                   err_msg=f"model_parallel={mp}")
+
+
+# ---------------------------------------------------------------------------
+# Production --tp through engine.run (one process, 8 fake devices)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_tp_run_surfaces_mesh_everywhere(tmp_path):
+    """A --tp 2 elastic run on the 8-device session (replicas are
+    process-local: group size 1, dp 4). The mesh layout must land in
+    status.json (boundary AND terminal records), the status CLI, the
+    run_start telemetry record, and `telemetry summarize`."""
+    from imagent_tpu.config import Config
+    from imagent_tpu.engine import run
+
+    cfg = Config(arch="vit_debug", image_size=16, num_classes=4,
+                 batch_size=1, epochs=1, dataset="synthetic",
+                 synthetic_size=32, workers=0, bf16=False, log_every=0,
+                 backend="cpu", seed=0, lr=0.05, eval_every=1,
+                 tp=2, elastic=True, global_batch=8,
+                 log_dir=str(tmp_path / "tb"),
+                 ckpt_dir=str(tmp_path / "ck"))
+    result = run(cfg)
+    assert result["final_train"]["n"] > 0
+
+    st = json.load(open(os.path.join(str(tmp_path), "tb",
+                                     "status.json")))
+    assert st["phase"] == "done"
+    assert st["mesh"]["layout"] == "dp4xtp2xpp1"
+    assert st["mesh"]["tp"] == 2 and st["mesh"]["dp"] == 4
+    assert st["mesh"]["group_size"] == 1  # replicas fit in-process
+    assert st["mesh"]["groups"] == 1      # one process -> one group
+    from imagent_tpu.status import render
+    screen = render(os.path.join(str(tmp_path), "tb"))
+    assert "mesh: dp4xtp2xpp1" in screen, screen
+
+    events = [json.loads(ln) for ln in
+              open(os.path.join(str(tmp_path), "tb",
+                                "telemetry.jsonl")) if ln.strip()]
+    rs = [e for e in events if e.get("event") == "run_start"]
+    assert rs and rs[0]["mesh"]["layout"] == "dp4xtp2xpp1"
+    eps = [e for e in events if e.get("event") == "epoch"]
+    assert eps, events
+    # The model-axis twin of the pod/world_size series.
+    assert eps[-1]["counters"]["groups"] == 1.0
+    assert eps[-1]["counters"]["world_size"] == 1.0
+    from imagent_tpu.telemetry.__main__ import summarize
+    table = summarize(os.path.join(str(tmp_path), "tb"))
+    assert "mesh: dp4xtp2xpp1" in table, table
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill (real OS processes through the real CLI)
+# ---------------------------------------------------------------------------
+
+
+def _launch_tp(phase: str, scratch: str, world: int, epochs: int,
+               timeout: float = 420):
+    from mp_launch import clean_env, free_port
+    port = free_port()
+    env = clean_env()
+    env["IMAGENT_MP_SCRATCH"] = scratch
+    env["IMAGENT_TP_PHASE"] = phase
+    env["IMAGENT_TP_EPOCHS"] = str(epochs)
+    env.pop("IMAGENT_FAULTS", None)  # per-rank arming happens inside
+    env.pop("IMAGENT_SAMPLE_TRACE", None)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(_DIR, "mp_worker_tp_pod.py"),
+         str(rank), str(port), str(world)],
+        cwd=_REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+        for rank in range(world)]
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs, [p.returncode for p in procs]
+
+
+def _events(scratch: str) -> list[dict]:
+    with open(os.path.join(scratch, "tb", "telemetry.jsonl")) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _group_leader_rows(scratch: str) -> list[dict]:
+    """Train-split trace records from each group's LOWEST launched rank
+    only: the group-keyed feed gives every member of a group the same
+    loader stream, so one member per group reconstructs the consumed
+    stream without double counting."""
+    recs = []
+    for f in glob.glob(os.path.join(scratch, "trace_r*.jsonl")):
+        m = re.search(r"trace_r(\d+)\.", os.path.basename(f))
+        if m is None or int(m.group(1)) % 2:
+            continue  # groups of 2: even launched ranks lead
+        with open(f) as fh:
+            for ln in fh:
+                rec = json.loads(ln)
+                if rec.get("split") == "train":
+                    recs.append(rec)
+    return recs
+
+
+def test_tp_pod_drill_group_death_shrink_resume_parity(tmp_path):
+    """THE ISSUE 16 acceptance drill:
+
+    * a REAL 4-process ``--tp 2`` pod (model groups {0,1} and {2,3})
+      loses rank 2's WHOLE group at step 3 via ``group.die`` (armed on
+      every rank; only the target's group dies);
+    * each survivor's deadman condemns the group — the ``pod_degraded``
+      event carries ``group [2, 3]`` — and the pod re-forms as ONE
+      group: ``pod_resized`` 4→2 processes with accum 6→12 (the
+      surviving data degree re-derives it; lr untouched), the salvage
+      landed from the surviving whole group and resharded;
+    * no sample is replayed or skipped across the kill, the shrunken
+      continuation, and the re-expanded epoch 1;
+    * a fresh 4-process resume re-expands to two groups (2→4, accum
+      12→6);
+    * the final loss matches the uninterrupted ``--tp 2`` run within
+      1%."""
+    scratch = str(tmp_path / "drill")
+    os.makedirs(scratch)
+
+    outs, rcs = _launch_tp("kill", scratch, 4, 1)
+    # The whole target group died with the fault's code; both the
+    # target rank AND its group partner print the group-death banner.
+    for r in (2, 3):
+        assert rcs[r] == 1, outs[r]
+        assert "FAULT group.die" in outs[r], outs[r]
+        assert "dead group [2, 3]" in outs[r], outs[r]
+    for r in (0, 1):
+        assert rcs[r] == 0, outs[r]
+        assert "elastic continue" in outs[r], outs[r]
+        assert "exec-restarting into the rendezvous" in outs[r]
+    joined = "\n".join(outs[:2])
+    assert "model group [2, 3] condemned" in joined
+    assert "emergency snapshot committed as LAST" in joined
+    assert "POD RESIZED: 4 -> 2" in joined
+    # No tombstones: group.die leaves none, and a resize is no death.
+    hb_dir = os.path.join(scratch, "tb", "heartbeats")
+    assert not [f for f in os.listdir(hb_dir)
+                if f.startswith("tombstone")]
+    # The verdict carried the whole group; the resize re-derived the
+    # accumulation from the surviving data degree at fixed G and lr.
+    degraded = [e for e in _events(scratch)
+                if e.get("event") == "pod_degraded"]
+    assert degraded and degraded[0]["peer"] in (2, 3)
+    assert degraded[0]["group"] == [2, 3]
+    assert degraded[0].get("continue") is True
+    resized = [e for e in _events(scratch)
+               if e.get("event") == "pod_resized"]
+    assert resized and resized[0]["from_processes"] == 4
+    assert resized[0]["to_processes"] == 2
+    assert resized[0]["grad_accum_prev"] == 6
+    assert resized[0]["grad_accum"] == 12
+    assert resized[0]["emergency"] == 1
+    assert resized[0]["resume_step"] == 3
+    # The degraded pod reads as a GROUP loss on one screen.
+    st = json.load(open(os.path.join(scratch, "tb", "status.json")))
+    assert st["world_size"] == 2 and st["launched_world_size"] == 4
+    assert st["phase"] == "done"
+    assert st["mesh"]["layout"] == "dp1xtp2xpp1"
+    assert st["mesh"]["group_size"] == 2
+    assert st["mesh"]["groups"] == 1
+    assert st["mesh"]["launched_groups"] == 2
+    from imagent_tpu.status import render
+    screen = render(os.path.join(scratch, "tb"),
+                    ckpt_dir=os.path.join(scratch, "ck"))
+    assert "mesh: dp1xtp2xpp1 — 1 model group(s) of 2 host(s)" \
+        in screen, screen
+    assert "1 group(s) DEGRADED" in screen, screen
+
+    # Phase 2: the replacement group arrived — a fresh 4-process pod
+    # re-expands to two groups and trains epoch 1.
+    outs2, rcs2 = _launch_tp("resume", scratch, 4, 2)
+    assert rcs2 == [0, 0, 0, 0], outs2
+    regrown = [e for e in _events(scratch)
+               if e.get("event") == "pod_resized"
+               and e.get("from_processes") == 2]
+    assert regrown and regrown[0]["to_processes"] == 4
+    assert regrown[0]["grad_accum_prev"] == 12
+    assert regrown[0]["grad_accum"] == 6
+    st2 = json.load(open(os.path.join(scratch, "tb", "status.json")))
+    assert st2["world_size"] == 4 and st2["phase"] == "done"
+    assert st2["mesh"]["groups"] == 2
+
+    # No sample replayed, none skipped: reconstruct the consumed
+    # stream from the group leaders' traces. Epoch 0 steps [0,3)
+    # belong to the 2-GROUP prefix, steps [3,8) to the 1-group
+    # continuation (the trace's world stamp is the GROUP count — the
+    # loader's world is groups, not ranks); epoch 1 is all 2-group.
+    key1 = StreamKey(num_examples=96, global_batch=12, seed=0,
+                     process_index=0, process_count=1, shuffle=True,
+                     drop_remainder=True)
+    recs = _group_leader_rows(scratch)
+    for epoch in (0, 1):
+        expected = {step: sorted(int(r) for r in rows)
+                    for step, rows in open_stream(key1, epoch)}
+        got: dict[int, list[int]] = {}
+        for rec in recs:
+            if rec["epoch"] != epoch:
+                continue
+            step, world = int(rec["step"]), int(rec["world"])
+            ok = (world == 2 if (epoch == 1 or step < 3)
+                  else world == 1)
+            if ok:
+                got.setdefault(step, []).extend(map(int, rec["rows"]))
+        assert {s: sorted(v) for s, v in got.items()} == expected, \
+            f"epoch {epoch}: consumed stream diverged"
+
+    # Loss parity vs the uninterrupted --tp 2 run (same seed, same
+    # --global-batch contract, 2 epochs straight through).
+    ref = str(tmp_path / "ref")
+    os.makedirs(ref)
+    outs3, rcs3 = _launch_tp("reference", ref, 4, 2)
+    assert rcs3 == [0, 0, 0, 0], outs3
+    ref_loss = json.load(open(os.path.join(ref, "tb",
+                                           "status.json")))["loss"]
+    drill_loss = st2["loss"]
+    assert ref_loss > 0
+    assert abs(drill_loss - ref_loss) / ref_loss < 0.01, \
+        (drill_loss, ref_loss)
