@@ -8,12 +8,15 @@ PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
 	drill-pod drill-divergence drill-elastic drill-sharded drill-tp \
 	trace-smoke slo-check slo-smoke
 
-# Static-analysis gate (docs/STATIC_ANALYSIS.md): jaxlint — the
-# JAX/TPU-aware rules in imagent_tpu/analysis — over the package, the
-# benchmarks, and the bench driver; exit != 0 on any unsuppressed
-# finding. ruff (correctness classes only, [tool.ruff] in
-# pyproject.toml) rides along when the binary exists; the CI image
-# doesn't ship it, so its absence is a skip, not a failure.
+# Static-analysis gate (docs/STATIC_ANALYSIS.md): ONE command runs
+# both layers — jaxlint (per-module JAX/TPU rules) and podlint (the
+# interprocedural collective-symmetry / deadman-gate / thread-
+# discipline / jax-free-manifest pass over the project call graph) —
+# across the package, the benchmarks, and the bench driver; exit != 0
+# on any unsuppressed finding. ~3s, no jax import. ruff (correctness
+# classes only, [tool.ruff] in pyproject.toml) rides along when the
+# binary exists; the CI image doesn't ship it, so its absence is a
+# skip, not a failure.
 lint:
 	$(PY) -m imagent_tpu.analysis imagent_tpu benchmarks bench.py
 	@if command -v ruff >/dev/null 2>&1; then \

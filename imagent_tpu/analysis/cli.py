@@ -7,10 +7,12 @@ Exit codes: 0 clean, 1 findings, 2 usage/configuration error — so
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 import os
 
+from imagent_tpu.analysis.podrules import PROJECT_RULES
 from imagent_tpu.analysis.rules import RULES
 from imagent_tpu.analysis.runner import (
     DEFAULT_BASELINE, load_baseline, run_paths, write_baseline,
@@ -35,7 +37,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="snapshot current findings into --baseline "
                         "(reasons stamped TODO — edit before commit)")
     p.add_argument("--select", metavar="RULE[,RULE...]",
-                   help="run only these rules")
+                   help="run only these rules (per-module or podlint)")
+    p.add_argument("--jaxfree-manifest", metavar="PATH",
+                   help="jax-free module manifest for the "
+                        "jax-free-violation rule (default: "
+                        "imagent_tpu/analysis/jaxfree.json)")
+    p.add_argument("--format", choices=("text", "json"),
+                   default="text",
+                   help="output format: human text (default) or a "
+                        "stable machine-readable JSON document")
     p.add_argument("--list-rules", action="store_true",
                    help="print each rule and why it bites on TPU")
     p.add_argument("-q", "--quiet", action="store_true",
@@ -46,14 +56,17 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        width = max(len(n) for n in RULES)
+        both = {**RULES, **PROJECT_RULES}
+        width = max(len(n) for n in both)
         for name, rule in sorted(RULES.items()):
             print(f"{name:<{width}}  {rule.doc}")
+        for name, rule in sorted(PROJECT_RULES.items()):
+            print(f"{name:<{width}}  [podlint] {rule.doc}")
         return 0
     select = None
     if args.select:
         select = {s.strip() for s in args.select.split(",") if s.strip()}
-        unknown = select - set(RULES)
+        unknown = select - set(RULES) - set(PROJECT_RULES)
         if unknown:
             print(f"jaxlint: unknown rule(s): {', '.join(sorted(unknown))}"
                   f" (see --list-rules)", file=sys.stderr)
@@ -71,7 +84,8 @@ def main(argv: list[str] | None = None) -> int:
         else args.baseline
     try:
         result = run_paths(args.paths, baseline_path=baseline,
-                           select=select)
+                           select=select,
+                           manifest_path=args.jaxfree_manifest)
     except (ValueError, OSError) as e:
         print(f"jaxlint: {e}", file=sys.stderr)
         return 2
@@ -92,6 +106,27 @@ def main(argv: list[str] | None = None) -> int:
                   "(bare-suppression / syntax-error) NOT grandfathered "
                   "— fix them at the source", file=sys.stderr)
         return 0
+    if args.format == "json":
+        # Stable machine-readable schema (format_version bumps on any
+        # breaking change) for CI and regress-style tooling.
+        doc = {
+            "format_version": 1,
+            "files_checked": result.files_checked,
+            "findings": [
+                {"path": f.path, "line": f.line, "col": f.col,
+                 "rule": f.rule, "message": f.message, "code": f.code}
+                for f in result.findings],
+            "suppressed": result.suppressed,
+            "baselined": result.baselined,
+            "stale_baseline": result.stale_baseline,
+            "unused_suppressions": [
+                {"path": p, "line": ln}
+                for p, ln in result.unused_suppressions],
+            "ok": result.ok,
+        }
+        json.dump(doc, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0 if result.ok else 1
     if not args.quiet:
         for f in result.findings:
             print(f.render())

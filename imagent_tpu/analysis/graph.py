@@ -1,0 +1,640 @@
+"""podlint project graph — the interprocedural layer under jaxlint.
+
+``rules.py`` looks at one module at a time; this module builds a
+whole-project view from the same parse trees: a function table with
+qualified ids, call + reference edges between project functions,
+thread entry points (``threading.Thread(target=...)`` and watchdog
+``add_monitor`` registrations, including the factory-closure idiom
+``add_monitor(commit_monitor(...))``), and a top-level import graph.
+The project rules in ``podrules.py`` consume it.
+
+Everything here is pure AST work — the code under analysis is never
+imported, and this module (like the rest of the analysis package)
+must never import jax.
+
+Resolution strategy, in decreasing confidence (precision over recall,
+the package-wide philosophy — an unresolvable call simply adds no
+edge):
+
+* plain names through the lexical scope chain (nested defs, then
+  module functions/classes, then ``from mod import f`` aliases);
+* ``self.m()`` / ``cls.m()`` through the enclosing class, walking
+  in-project base classes;
+* ``alias.f()`` where ``alias`` binds a project module;
+* ``x.m()`` where ``x = SomeProjectClass(...)`` earlier in the same
+  function body (single-assignment local type inference);
+* last, a unique-method fallback: if exactly one project class
+  defines method ``m`` and ``m`` is not an ultra-common name, an
+  unresolved ``obj.m()`` binds to it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterator
+
+from .rules import ModuleContext, _iter_defs, _own_body_walk, _qualname
+
+# Host-level multihost collectives.  ``assert_equal`` is unambiguous
+# in this tree (numpy.testing is not used in lint scope) but is still
+# guarded against numpy-prefixed quals below.  In-graph collectives
+# (psum/pmean inside shard_map) are deliberately out of scope: they
+# are symmetric by construction once dispatch is symmetric.
+COLLECTIVE_ATTRS = {"process_allgather", "broadcast_one_to_all",
+                    "sync_global_devices", "assert_equal"}
+_COLLECTIVE_PREFIX = "jax.experimental.multihost_utils."
+
+GATE_NAME = "raise_if_degraded"
+
+# Method names too generic for the unique-method fallback: binding
+# ``q.get()`` to some project class just because only one class in
+# scope happens to define ``get`` would wire stdlib queues/dicts/etc.
+# into the call graph.
+_COMMON_METHODS = {
+    "get", "put", "set", "add", "pop", "append", "extend", "update",
+    "remove", "clear", "copy", "keys", "values", "items", "start",
+    "stop", "join", "close", "open", "run", "read", "write", "flush",
+    "send", "recv", "wait", "notify", "acquire", "release", "submit",
+    "result", "cancel", "load", "save", "restore", "reset", "next",
+    "serve_forever", "shutdown", "check", "note", "observe",
+    "render", "name", "fileno", "encode", "decode", "format", "count",
+    "index", "sort", "split", "strip", "item", "tolist", "mean",
+}
+
+
+def module_name(rel_path: str) -> str:
+    """``imagent_tpu/data/stream.py`` → ``imagent_tpu.data.stream``;
+    a package ``__init__.py`` maps to the package itself."""
+    p = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    p = p.replace("/", ".").replace(os.sep, ".")
+    if p.endswith(".__init__"):
+        p = p[: -len(".__init__")]
+    return p
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One analysis scope: a def/method, or a module's top level."""
+    fid: str                 # "pkg.mod:C.m", "pkg.mod:<module>"
+    modname: str
+    qualpath: str            # "f", "C.m", "f.<locals>.g", "<module>"
+    node: ast.AST            # FunctionDef/AsyncFunctionDef, or Module
+    parent: str | None       # enclosing scope's fid
+    cls: str | None = None   # qualified class path when a method
+
+
+@dataclasses.dataclass
+class Edge:
+    caller: str
+    callee: str
+    pos: tuple[int, int]     # site position inside the caller
+    kind: str                # "call" (invoked) | "ref" (passed/stored)
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class ThreadEntry:
+    fid: str                 # the function that runs off-main-thread
+    via: str                 # "thread-target" | "monitor"
+    site_fid: str            # where the registration happens
+    node: ast.AST            # the registration call
+
+
+@dataclasses.dataclass
+class CollectiveSite:
+    fid: str
+    node: ast.Call
+    name: str                # the collective primitive's attr name
+
+
+class _ClassEntry:
+    def __init__(self) -> None:
+        self.module: str = ""               # owning module
+        self.methods: dict[str, str] = {}   # name -> fid
+        self.bases: list[str] = []          # qualified "mod.C" names
+
+
+class ProjectGraph:
+    """Import graph + call graph over a set of parsed modules."""
+
+    def __init__(self, contexts: list[ModuleContext]):
+        self.modules: dict[str, ModuleContext] = {
+            module_name(c.rel_path): c for c in contexts}
+        self.functions: dict[str, FuncInfo] = {}
+        self.edges: list[Edge] = []
+        self.out_edges: dict[str, list[Edge]] = {}
+        self.in_edges: dict[str, list[Edge]] = {}
+        self.thread_entries: list[ThreadEntry] = []
+        self.collective_sites: list[CollectiveSite] = []
+        # modname -> [(imported module name, anchoring AST node)], from
+        # TOP-LEVEL imports only: function-scope (lazy) imports are the
+        # sanctioned jax-avoidance idiom and do not run at import time.
+        self.imports: dict[str, list[tuple[str, ast.AST]]] = {}
+
+        self._mod_funcs: dict[str, dict[str, str]] = {}    # top-level defs
+        self._mod_classes: dict[str, dict[str, str]] = {}  # name -> "mod.C"
+        self._classes: dict[str, _ClassEntry] = {}         # "mod.C"
+        self._nested: dict[str, dict[str, str]] = {}       # fid -> kids
+        self._direct_gates: dict[str, list[tuple[int, int]]] = {}
+        self._gate_pos: dict[str, list[tuple[int, int]]] = {}
+        self._methods_by_name: dict[str, list[str]] = {}
+
+        for mod, ctx in self.modules.items():
+            self._collect_defs(mod, ctx)
+        for mod, ctx in self.modules.items():
+            self._collect_imports(mod, ctx)
+            self._resolve_bases(mod, ctx)
+        for mod, ctx in self.modules.items():
+            self._collect_edges(mod, ctx)
+        for e in self.edges:
+            self.out_edges.setdefault(e.caller, []).append(e)
+            self.in_edges.setdefault(e.callee, []).append(e)
+
+    # ------------------------------------------------------------ tables
+
+    def _collect_defs(self, mod: str, ctx: ModuleContext) -> None:
+        root_fid = f"{mod}:<module>"
+        self.functions[root_fid] = FuncInfo(
+            root_fid, mod, "<module>", ctx.tree, None)
+        self._mod_funcs[mod] = {}
+        self._mod_classes[mod] = {}
+
+        def visit(node: ast.AST, qual: list[str], parent: str,
+                  cls: str | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    path = ".".join([*qual, child.name])
+                    fid = f"{mod}:{path}"
+                    self.functions[fid] = FuncInfo(
+                        fid, mod, path, child, parent, cls)
+                    if not qual:
+                        self._mod_funcs[mod][child.name] = fid
+                    self._nested.setdefault(parent, {})[child.name] = fid
+                    visit(child, [*qual, child.name, "<locals>"],
+                          fid, None)
+                elif isinstance(child, ast.ClassDef):
+                    cpath = ".".join([*qual, child.name])
+                    ckey = f"{mod}.{cpath}"
+                    entry = self._classes.setdefault(ckey, _ClassEntry())
+                    entry.module = mod
+                    entry.bases = [
+                        b for b in (
+                            _qualname(base, ctx.aliases)
+                            for base in child.bases) if b]
+                    if not qual:
+                        self._mod_classes[mod][child.name] = ckey
+                    for m in child.body:
+                        if isinstance(m, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                            mpath = f"{cpath}.{m.name}"
+                            fid = f"{mod}:{mpath}"
+                            self.functions[fid] = FuncInfo(
+                                fid, mod, mpath, m, parent, cpath)
+                            entry.methods[m.name] = fid
+                            self._methods_by_name.setdefault(
+                                m.name, []).append(fid)
+                            visit(m, [cpath, m.name, "<locals>"],
+                                  fid, None)
+                        else:
+                            visit_cls_stmt(m, qual, parent, cpath)
+                else:
+                    visit(child, qual, parent, cls)
+
+        def visit_cls_stmt(node: ast.AST, qual: list[str], parent: str,
+                           cpath: str) -> None:
+            # Non-def statements in a class body run at import time in
+            # the module pseudo-scope; nested classes recurse.
+            visit(node, [cpath], parent, cpath)
+
+        visit(ctx.tree, [], root_fid, None)
+
+    def _resolve_bases(self, mod: str, ctx: ModuleContext) -> None:
+        for ckey, entry in list(self._classes.items()):
+            if entry.module != mod:
+                continue
+            resolved = []
+            for b in entry.bases:
+                got = self._resolve_class_name(mod, b)
+                if got:
+                    resolved.append(got)
+            entry.bases = resolved
+
+    def _resolve_class_name(self, mod: str, dotted: str) -> str | None:
+        if dotted in self._mod_classes.get(mod, {}):
+            return self._mod_classes[mod][dotted]
+        if dotted in self._classes:
+            return dotted
+        # "pkg.mod.C" via an import alias
+        head, _, tail = dotted.rpartition(".")
+        if head in self.modules and tail in self._mod_classes.get(
+                head, {}):
+            return self._mod_classes[head][tail]
+        return None
+
+    # ----------------------------------------------------------- imports
+
+    def _collect_imports(self, mod: str, ctx: ModuleContext) -> None:
+        out: list[tuple[str, ast.AST]] = []
+
+        def is_type_checking(test: ast.AST) -> bool:
+            q = _qualname(test, ctx.aliases)
+            return q is not None and q.endswith("TYPE_CHECKING")
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue  # lazy imports: the sanctioned idiom
+                if isinstance(child, ast.If) and \
+                        is_type_checking(child.test):
+                    continue
+                if isinstance(child, ast.Import):
+                    for a in child.names:
+                        out.append((a.name, child))
+                elif isinstance(child, ast.ImportFrom):
+                    base = child.module or ""
+                    if child.level:
+                        pkg = mod.split(".")
+                        if os.path.basename(
+                                ctx.rel_path) != "__init__.py":
+                            pkg = pkg[:-1]
+                        pkg = pkg[: len(pkg) - child.level + 1]
+                        base = ".".join(pkg + ([base] if base else []))
+                    if base:
+                        out.append((base, child))
+                    for a in child.names:
+                        sub = f"{base}.{a.name}" if base else a.name
+                        # "from pkg import submodule" imports a module;
+                        # "from pkg.mod import fn" does not add an edge
+                        # beyond pkg.mod itself.
+                        if sub in self.modules or sub.split(
+                                ".")[0] in ("jax", "jaxlib"):
+                            out.append((sub, child))
+                else:
+                    walk(child)
+
+        walk(ctx.tree)
+        self.imports[mod] = out
+
+    def import_closure(self, mod: str) -> dict[str, list[str]]:
+        """Transitive top-level imports of ``mod`` restricted to
+        project modules, each mapped to the chain of project modules
+        that reaches it (``[mod, ..., target]``).  Importing a module
+        executes every ancestor package ``__init__`` too, so those are
+        folded in at each step."""
+        chains: dict[str, list[str]] = {}
+        stack: list[tuple[str, list[str]]] = []
+        for m in self._with_ancestors(mod):
+            chains[m] = [m] if m == mod else [mod, m]
+            stack.append((m, chains[m]))
+        while stack:
+            cur, chain = stack.pop()
+            for target, _node in self.imports.get(cur, ()):
+                for t in self._with_ancestors(target):
+                    if t in self.modules and t not in chains:
+                        chains[t] = chain + [t]
+                        stack.append((t, chains[t]))
+        return chains
+
+    def _with_ancestors(self, mod: str) -> list[str]:
+        parts = mod.split(".")
+        return [".".join(parts[: i + 1]) for i in range(len(parts))]
+
+    # ------------------------------------------------------------- edges
+
+    def _collect_edges(self, mod: str, ctx: ModuleContext) -> None:
+        for fid, info in self.functions.items():
+            if info.modname != mod:
+                continue
+            body = list(
+                _own_body_walk(info.node) if info.qualpath !=
+                "<module>" else self._module_scope_walk(info.node))
+            local_types = self._local_types(mod, ctx, body)
+            for node in body:
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if (isinstance(f, ast.Attribute) and f.attr == GATE_NAME) \
+                        or (isinstance(f, ast.Name) and f.id == GATE_NAME):
+                    self._direct_gates.setdefault(fid, []).append(
+                        (node.lineno, node.col_offset))
+                self._record_call(mod, ctx, info, node, local_types)
+
+    def _module_scope_walk(self, tree: ast.AST) -> Iterator[ast.AST]:
+        """Module top level, descending into class bodies (they run at
+        import) but not into function bodies."""
+        stack = list(ast.iter_child_nodes(tree))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _local_types(self, mod: str, ctx: ModuleContext,
+                     body: list[ast.AST]) -> dict[str, str]:
+        """``x = SomeProjectClass(...)`` single-assignment inference
+        within one function body: name -> qualified class."""
+        types: dict[str, str] = {}
+        for node in body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                q = _qualname(node.value.func, ctx.aliases)
+                ckey = self._resolve_class_name(mod, q) if q else None
+                name = node.targets[0].id
+                if ckey:
+                    if name in types and types[name] != ckey:
+                        types[name] = ""  # conflicting: give up
+                    elif name not in types:
+                        types[name] = ckey
+                else:
+                    types.setdefault(name, "")
+        return {k: v for k, v in types.items() if v}
+
+    def _record_call(self, mod: str, ctx: ModuleContext, info: FuncInfo,
+                     node: ast.Call,
+                     local_types: dict[str, str]) -> None:
+        pos = (node.lineno, node.col_offset)
+        callee = self._resolve_callable(mod, ctx, info, node.func,
+                                        local_types)
+        if callee:
+            self.edges.append(Edge(info.fid, callee, pos, "call", node))
+
+        # Collective primitive site?
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in COLLECTIVE_ATTRS:
+            q = _qualname(node.func, ctx.aliases)
+            if not (q and (q.startswith("numpy.")
+                           or q.startswith("np."))):
+                self.collective_sites.append(
+                    CollectiveSite(info.fid, node, node.func.attr))
+        else:
+            q = _qualname(node.func, ctx.aliases)
+            if q and q.startswith(_COLLECTIVE_PREFIX):
+                self.collective_sites.append(
+                    CollectiveSite(info.fid, node, q.rsplit(".", 1)[-1]))
+
+        # Thread target / monitor registration.
+        fq = _qualname(node.func, ctx.aliases)
+        is_thread = fq == "threading.Thread" or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "Thread")
+        if is_thread:
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    t = self._resolve_callable(
+                        mod, ctx, info, kw.value, local_types)
+                    if t:
+                        self.thread_entries.append(
+                            ThreadEntry(t, "thread-target", info.fid,
+                                        node))
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "add_monitor":
+            for arg in node.args:
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    t = self._resolve_callable(
+                        mod, ctx, info, arg, local_types)
+                    if t:
+                        self.thread_entries.append(
+                            ThreadEntry(t, "monitor", info.fid, node))
+                elif isinstance(arg, ast.Call):
+                    # Factory-closure idiom: add_monitor(make_check(..))
+                    # — the factory's nested defs run off-thread.
+                    t = self._resolve_callable(
+                        mod, ctx, info, arg.func, local_types)
+                    if t:
+                        for kid in self._nested.get(t, {}).values():
+                            self.thread_entries.append(
+                                ThreadEntry(kid, "monitor", info.fid,
+                                            node))
+
+        # Reference edges: a project function passed as an argument
+        # (functools.partial targets, Thread targets, callbacks).
+        for arg in [*node.args,
+                    *(kw.value for kw in node.keywords)]:
+            if isinstance(arg, (ast.Name, ast.Attribute)):
+                t = self._resolve_callable(mod, ctx, info, arg,
+                                           local_types)
+                if t:
+                    self.edges.append(
+                        Edge(info.fid, t,
+                             (arg.lineno, arg.col_offset), "ref", arg))
+
+    def _resolve_callable(self, mod: str, ctx: ModuleContext,
+                          info: FuncInfo, expr: ast.AST,
+                          local_types: dict[str, str]) -> str | None:
+        if isinstance(expr, ast.Name):
+            return self._resolve_plain_name(mod, info, expr.id,
+                                            ctx)
+        if not isinstance(expr, ast.Attribute):
+            return None
+        base, attr = expr.value, expr.attr
+        # self.m() / cls.m() through the enclosing class (+ bases).
+        if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+            cls = self._enclosing_class(info)
+            if cls:
+                return self._lookup_method(f"{mod}.{cls}", attr)
+            return None
+        # x.m() where x was assigned a project-class instance.
+        if isinstance(base, ast.Name) and base.id in local_types:
+            return self._lookup_method(local_types[base.id], attr)
+        q = _qualname(expr, ctx.aliases)
+        if q:
+            head, _, tail = q.rpartition(".")
+            # alias.f() where alias binds a project module
+            if head in self.modules:
+                if tail in self._mod_funcs.get(head, {}):
+                    return self._mod_funcs[head][tail]
+                if tail in self._mod_classes.get(head, {}):
+                    return self._lookup_method(
+                        self._mod_classes[head][tail], "__init__")
+            # Module.Class.method (rare static access)
+            ckey = self._resolve_class_name(mod, head) if head else None
+            if ckey:
+                return self._lookup_method(ckey, attr)
+            if q.split(".")[0] in ("numpy", "np", "jax", "os", "sys",
+                                   "time", "json", "math", "logging",
+                                   "threading", "queue", "subprocess"):
+                return None
+        # Unique-method fallback.
+        if attr not in _COMMON_METHODS and not attr.startswith("__"):
+            cands = self._methods_by_name.get(attr, ())
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def _resolve_plain_name(self, mod: str, info: FuncInfo, name: str,
+                            ctx: ModuleContext) -> str | None:
+        # Lexical chain: nested defs of enclosing scopes, innermost out.
+        cur: FuncInfo | None = info
+        while cur is not None:
+            kids = self._nested.get(cur.fid, {})
+            if name in kids:
+                return kids[name]
+            cur = self.functions.get(cur.parent) if cur.parent else None
+        if name in self._mod_funcs.get(mod, {}):
+            return self._mod_funcs[mod][name]
+        if name in self._mod_classes.get(mod, {}):
+            return self._lookup_method(
+                self._mod_classes[mod][name], "__init__")
+        dotted = ctx.aliases.get(name)
+        if dotted and dotted != name:
+            head, _, tail = dotted.rpartition(".")
+            if head in self.modules:
+                if tail in self._mod_funcs.get(head, {}):
+                    return self._mod_funcs[head][tail]
+                if tail in self._mod_classes.get(head, {}):
+                    return self._lookup_method(
+                        self._mod_classes[head][tail], "__init__")
+        return None
+
+    def _enclosing_class(self, info: FuncInfo) -> str | None:
+        cur: FuncInfo | None = info
+        while cur is not None:
+            if cur.cls:
+                return cur.cls
+            cur = self.functions.get(cur.parent) if cur.parent else None
+        return None
+
+    def _lookup_method(self, ckey: str, attr: str) -> str | None:
+        seen: set[str] = set()
+        queue = [ckey]
+        while queue:
+            c = queue.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            entry = self._classes.get(c)
+            if entry is None:
+                continue
+            if attr in entry.methods:
+                return entry.methods[attr]
+            queue.extend(entry.bases)
+        return None
+
+    # --------------------------------------------------------- analyses
+
+    def gate_positions(self, fid: str) -> list[tuple[int, int]]:
+        """Source positions of deadman-gate events inside ``fid``'s own
+        body: direct ``raise_if_degraded`` calls plus calls into
+        functions known (transitively) to gate."""
+        cached = self._gate_pos.get(fid)
+        if cached is not None:
+            return cached
+        gating = self.gating_functions()
+        out = list(self._direct_gates.get(fid, ()))
+        for e in self.out_edges.get(fid, ()):
+            if e.kind == "call" and e.callee in gating:
+                out.append(e.pos)
+        out.sort()
+        self._gate_pos[fid] = out
+        return out
+
+    def gating_functions(self) -> set[str]:
+        """Functions that perform a deadman gate themselves or via a
+        (transitive) direct call."""
+        if not hasattr(self, "_gating"):
+            direct = set(self._direct_gates)
+            changed = True
+            while changed:
+                changed = False
+                for e in self.edges:
+                    if e.kind == "call" and e.callee in direct \
+                            and e.caller not in direct:
+                        direct.add(e.caller)
+                        changed = True
+            self._gating = direct
+        return self._gating
+
+    def collective_reaching(self) -> set[str]:
+        """Functions from which a collective primitive is reachable
+        through call/ref edges."""
+        reach = {s.fid for s in self.collective_sites}
+        changed = True
+        while changed:
+            changed = False
+            for e in self.edges:
+                if e.callee in reach and e.caller not in reach:
+                    reach.add(e.caller)
+                    changed = True
+        return reach
+
+    def entry_gated(self) -> dict[str, bool]:
+        """Greatest fixpoint: fid -> True when EVERY path into the
+        function passes a deadman gate first (either the caller gates
+        before the call site, or the caller itself is entry-gated).
+        Module top levels and thread entries are never entry-gated."""
+        gated = {fid: True for fid in self.functions}
+        pinned: set[str] = set()
+        for fid, info in self.functions.items():
+            if info.qualpath == "<module>" or not self.in_edges.get(fid):
+                gated[fid] = False
+                pinned.add(fid)
+        for t in self.thread_entries:
+            gated[t.fid] = False
+            pinned.add(t.fid)
+        gate_pos = {fid: self.gate_positions(fid)
+                    for fid in self.functions}
+        changed = True
+        while changed:
+            changed = False
+            for fid in self.functions:
+                if fid in pinned or not gated[fid]:
+                    continue
+                ok = True
+                for e in self.in_edges.get(fid, ()):
+                    before = any(p < e.pos for p in gate_pos[e.caller])
+                    if not (before or gated[e.caller]):
+                        ok = False
+                        break
+                if not ok:
+                    gated[fid] = False
+                    changed = True
+        return gated
+
+    def ungated_path(self, fid: str,
+                     gated: dict[str, bool]) -> list[str]:
+        """An example call chain root → ... → ``fid`` along which no
+        gate is passed, for finding messages."""
+        gate_pos: dict[str, list[tuple[int, int]]] = {}
+        path = [fid]
+        seen = {fid}
+        cur = fid
+        while True:
+            info = self.functions.get(cur)
+            nxt = None
+            for e in self.in_edges.get(cur, ()):
+                if e.caller in seen:
+                    continue
+                pos = gate_pos.setdefault(
+                    e.caller, self.gate_positions(e.caller))
+                if not any(p < e.pos for p in pos) and \
+                        not gated.get(e.caller, False):
+                    nxt = e.caller
+                    break
+            if nxt is None or info is None:
+                break
+            path.append(nxt)
+            seen.add(nxt)
+            cur = nxt
+            if self.functions[cur].qualpath == "<module>":
+                break
+        return list(reversed(path))
+
+    def reachable_from(self, fids: list[str]) -> dict[str, list[str]]:
+        """BFS over call+ref edges: fid -> example chain from one of
+        the given entry points."""
+        chains: dict[str, list[str]] = {f: [f] for f in fids}
+        queue = list(fids)
+        while queue:
+            cur = queue.pop(0)
+            for e in self.out_edges.get(cur, ()):
+                if e.callee not in chains:
+                    chains[e.callee] = chains[cur] + [e.callee]
+                    queue.append(e.callee)
+        return chains

@@ -1,0 +1,422 @@
+"""podlint project rules — interprocedural checks over ProjectGraph.
+
+These close the blind spot ``docs/STATIC_ANALYSIS.md`` used to record
+("per-module and mostly per-function"): every costly review-caught
+defect class in this repo's history has been a cross-function
+collective-discipline violation, and each rule here encodes one of
+them.  Same philosophy as ``rules.py``: precision over recall, empty
+baseline, suppressions carry justifications.
+
+Rules live in their own registry (``PROJECT_RULES``) so the
+per-module registry keeps its exact shape for existing tooling.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Callable, Iterator
+
+from .graph import ProjectGraph
+from .rules import (Finding, _HOST_FETCH_CALLS, _HOST_FETCH_METHODS,
+                    _TRACER_COERCIONS, _own_body_walk, _param_names,
+                    _qualname, _rooted_at_param)
+
+DEFAULT_MANIFEST = os.path.join(os.path.dirname(__file__),
+                                "jaxfree.json")
+
+
+@dataclasses.dataclass
+class PodlintConfig:
+    """Knobs the project rules need beyond the graph itself."""
+    manifest: dict | None = None       # parsed jaxfree.json
+    manifest_path: str | None = None   # where it came from (messages)
+
+
+@dataclasses.dataclass
+class ProjectRule:
+    name: str
+    doc: str
+    check: Callable[[ProjectGraph, PodlintConfig], Iterator[Finding]]
+
+
+PROJECT_RULES: dict[str, ProjectRule] = {}
+
+
+def project_rule(name: str, doc: str):
+    def deco(fn):
+        PROJECT_RULES[name] = ProjectRule(name, doc, fn)
+        return fn
+    return deco
+
+
+def load_manifest(path: str) -> dict:
+    """Parsed + validated jax-free manifest."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    mods = data.get("modules")
+    if not isinstance(mods, list) or \
+            not all(isinstance(m, str) and m for m in mods):
+        raise ValueError(
+            f"{path}: 'modules' must be a list of dotted module names")
+    return data
+
+
+def run_project_rules(graph: ProjectGraph,
+                      select: set[str] | None = None,
+                      config: PodlintConfig | None = None
+                      ) -> list[Finding]:
+    config = config or PodlintConfig()
+    out: list[Finding] = []
+    for name, rule in PROJECT_RULES.items():
+        if select is not None and name not in select:
+            continue
+        out.extend(rule.check(graph, config))
+    return out
+
+
+def _short(fid: str) -> str:
+    """"imagent_tpu.engine:run" → "engine:run" for readable chains."""
+    mod, _, qual = fid.partition(":")
+    return f"{mod.rsplit('.', 1)[-1]}:{qual}"
+
+
+def _site_finding(graph: ProjectGraph, fid: str, node: ast.AST,
+                  rule: str, message: str) -> Finding:
+    info = graph.functions[fid]
+    return graph.modules[info.modname].finding(node, rule, message)
+
+
+# --------------------------------------------------------------------------
+# Rule 1: ungated-collective
+# --------------------------------------------------------------------------
+
+@project_rule(
+    "ungated-collective",
+    "a multihost collective reachable without passing "
+    "deadman.raise_if_degraded — a degraded pod hangs on it forever")
+def check_ungated_collective(graph: ProjectGraph,
+                             config: PodlintConfig
+                             ) -> Iterator[Finding]:
+    """Replaces the PR 7/14 hand audits.  A collective site is safe
+    when a gate event precedes it in the same function body, or when
+    every call path into its function passes a gate first (the
+    entry-gated fixpoint).  Module top levels and thread entries are
+    never entry-gated."""
+    gated = graph.entry_gated()
+    gate_pos = {fid: graph.gate_positions(fid)
+                for fid in sorted({s.fid
+                                   for s in graph.collective_sites})}
+    for site in graph.collective_sites:
+        pos = (site.node.lineno, site.node.col_offset)
+        if any(p < pos for p in gate_pos[site.fid]):
+            continue
+        if gated.get(site.fid, False):
+            continue
+        chain = " -> ".join(
+            _short(f) for f in graph.ungated_path(site.fid, gated))
+        yield _site_finding(
+            graph, site.fid, site.node, "ungated-collective",
+            f"multihost collective `{site.name}` is reachable without "
+            f"a deadman gate (ungated path: {chain}); call "
+            "deadman.raise_if_degraded() before it so a degraded pod "
+            "takes the exit ramp instead of hanging on a dead peer")
+
+
+# --------------------------------------------------------------------------
+# Rule 2: asymmetric-collective
+# --------------------------------------------------------------------------
+
+_RANK_NAMES = {"rank", "is_master", "master", "is_lead", "lead",
+               "leader", "is_coordinator", "is_primary", "local_rank"}
+
+
+def _is_rank_conditional(test: ast.AST, aliases: dict) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and (
+                n.id in _RANK_NAMES or n.id.endswith("_rank")):
+            return True
+        target = n.func if isinstance(n, ast.Call) else n
+        if isinstance(target, ast.Attribute):
+            q = _qualname(target, aliases)
+            if q and ("process_index" in q or q.endswith(".rank")
+                      or "is_master" in q):
+                return True
+    return False
+
+
+def _terminates(stmts: list[ast.stmt]) -> bool:
+    return any(isinstance(s, (ast.Return, ast.Raise)) for s in stmts)
+
+
+@project_rule(
+    "asymmetric-collective",
+    "a collective reachable only under a rank-conditional branch — "
+    "the other ranks block forever (split-brain hang)")
+def check_asymmetric_collective(graph: ProjectGraph,
+                                config: PodlintConfig
+                                ) -> Iterator[Finding]:
+    """The PR 5 defect class: a collective (or a call into a
+    collective-reaching function) under ``if process_index() == 0:``
+    with no all-ranks counterpart in the other branch, or after a
+    rank-guarded early return."""
+    reach = graph.collective_reaching()
+    prim_nodes = {id(s.node) for s in graph.collective_sites}
+    for fid, info in graph.functions.items():
+        ctx = graph.modules[info.modname]
+        ish: dict[int, tuple[ast.Call, str]] = {}
+        for s in graph.collective_sites:
+            if s.fid == fid:
+                ish[id(s.node)] = (s.node, f"collective `{s.name}`")
+        for e in graph.out_edges.get(fid, ()):
+            if e.kind == "call" and e.callee in reach \
+                    and id(e.node) not in ish \
+                    and id(e.node) not in prim_nodes:
+                ish[id(e.node)] = (
+                    e.node,
+                    f"call into collective-reaching "
+                    f"`{_short(e.callee)}`")
+        if not ish:
+            continue
+
+        root = info.node if info.qualpath != "<module>" else None
+        if root is None:
+            continue
+
+        def branch_has_ish(stmts: list[ast.stmt]) -> bool:
+            for s in stmts:
+                for n in ast.walk(s):
+                    if id(n) in ish:
+                        return True
+            return False
+
+        early_returns: list[ast.If] = []
+        sites: list[tuple[ast.Call, str, list[tuple[ast.If, str]]]] = []
+
+        def walk(node: ast.AST,
+                 conds: list[tuple[ast.If, str]]) -> None:
+            if id(node) in ish:
+                n, why = ish[id(node)]
+                sites.append((n, why, list(conds)))
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, ast.If):
+                walk(node.test, conds)
+                rc = _is_rank_conditional(node.test, ctx.aliases)
+                if rc and _terminates(node.body) and not node.orelse:
+                    early_returns.append(node)
+                tag = "rank" if rc else "plain"
+                for s in node.body:
+                    walk(s, conds + [(node, f"body:{tag}")])
+                for s in node.orelse:
+                    walk(s, conds + [(node, f"orelse:{tag}")])
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child, conds)
+
+        for stmt in root.body:
+            walk(stmt, [])
+
+        seen: set[tuple[int, int]] = set()
+        for node, why, conds in sites:
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            guard = next(
+                ((ifn, branch) for ifn, branch in reversed(conds)
+                 if branch.endswith(":rank")), None)
+            if guard is not None:
+                ifn, branch = guard
+                other = ifn.orelse if branch.startswith("body") \
+                    else ifn.body
+                if not branch_has_ish(other):
+                    yield _site_finding(
+                        graph, fid, node, "asymmetric-collective",
+                        f"{why} runs only under the rank-conditional "
+                        f"branch at line {ifn.lineno} with no "
+                        "collective counterpart on the other ranks — "
+                        "they block in the next collective forever "
+                        "(split-brain hang); hoist the collective out "
+                        "of the branch or give every rank a matching "
+                        "call")
+                continue
+            for ifn in early_returns:
+                end = getattr(ifn, "end_lineno", ifn.lineno)
+                if node.lineno > end:
+                    yield _site_finding(
+                        graph, fid, node, "asymmetric-collective",
+                        f"{why} executes only on ranks that survive "
+                        f"the rank-guarded early return at line "
+                        f"{ifn.lineno} — the returning ranks never "
+                        "reach it and the rest hang; move the "
+                        "collective above the guard or make the "
+                        "guard symmetric")
+                    break
+
+
+# --------------------------------------------------------------------------
+# Rule 3: collective-in-thread
+# --------------------------------------------------------------------------
+
+@project_rule(
+    "collective-in-thread",
+    "a multihost collective reachable from a Thread target or "
+    "registered monitor — collectives must stay on the main thread")
+def check_collective_in_thread(graph: ProjectGraph,
+                               config: PodlintConfig
+                               ) -> Iterator[Finding]:
+    """Static complement of the runtime collective fence: committer
+    threads, monitors, and heartbeat writers run exactly when the
+    main thread may be wedged in a collective, so a second collective
+    from one of them deadlocks the coordination service."""
+    entries = {t.fid: t for t in graph.thread_entries}
+    if not entries:
+        return
+    chains = graph.reachable_from(list(entries))
+    for site in graph.collective_sites:
+        chain = chains.get(site.fid)
+        if chain is None:
+            continue
+        entry = entries[chain[0]]
+        path = " -> ".join(_short(f) for f in chain)
+        yield _site_finding(
+            graph, site.fid, site.node, "collective-in-thread",
+            f"multihost collective `{site.name}` is reachable from "
+            f"off-main-thread entry point `{_short(entry.fid)}` "
+            f"({entry.via} registered in `{_short(entry.site_fid)}`): "
+            f"{path}; background threads are collective-free by "
+            "contract — return a verdict to the main thread instead")
+
+
+# --------------------------------------------------------------------------
+# Rule 4: jax-free-violation
+# --------------------------------------------------------------------------
+
+@project_rule(
+    "jax-free-violation",
+    "a module declared jax-free in analysis/jaxfree.json whose "
+    "top-level import closure reaches jax")
+def check_jax_free(graph: ProjectGraph,
+                   config: PodlintConfig) -> Iterator[Finding]:
+    """Single source of truth for the no-device-handles contract:
+    modules on the fatal-exit, per-step, decode-host, and
+    committer-thread paths must be importable without pulling the JAX
+    runtime.  Function-scope (lazy) imports are the sanctioned escape
+    hatch and are ignored by construction.  Manifest entries absent
+    from the linted tree are skipped — the consolidated import test
+    (tests/test_jaxfree.py) catches genuinely stale entries."""
+    manifest = config.manifest
+    if manifest is None and config.manifest_path and \
+            os.path.exists(config.manifest_path):
+        manifest = load_manifest(config.manifest_path)
+    if not manifest:
+        return
+    where = config.manifest_path or "the jax-free manifest"
+    reported: set[tuple[str, int]] = set()
+    for declared in manifest.get("modules", ()):
+        if declared not in graph.modules:
+            continue
+        chains = graph.import_closure(declared)
+        for mod, chain in sorted(chains.items()):
+            for target, node in graph.imports.get(mod, ()):
+                if target.split(".")[0] not in ("jax", "jaxlib"):
+                    continue
+                key = (mod, getattr(node, "lineno", 1))
+                if key in reported:
+                    continue
+                reported.add(key)
+                via = " -> ".join(chain) if len(chain) > 1 else mod
+                yield graph.modules[mod].finding(
+                    node, "jax-free-violation",
+                    f"`{declared}` is declared jax-free ({where}) but "
+                    f"its top-level import closure reaches jax: {via} "
+                    f"-> {target}; make this import lazy "
+                    "(function-scope) or remove the module from the "
+                    "manifest")
+
+
+# --------------------------------------------------------------------------
+# Rule 5: host-sync-in-jit-helper
+# --------------------------------------------------------------------------
+
+@project_rule(
+    "host-sync-in-jit-helper",
+    "a helper called from a jitted body with a traced argument "
+    "fetches it to host — the documented one-call-level blind spot")
+def check_host_sync_helper(graph: ProjectGraph,
+                           config: PodlintConfig) -> Iterator[Finding]:
+    """Call-graph-aware extension of host-sync-in-jit one level into
+    helpers.  Only helper parameters that actually receive a traced
+    value at the call site are tainted, so trace-time numpy on static
+    shapes stays legal."""
+    node_to_fid = {id(info.node): fid
+                   for fid, info in graph.functions.items()}
+    jit_nodes = set()
+    for ctx in graph.modules.values():
+        for fn, _static in ctx.jit_bodies:
+            jit_nodes.add(id(fn))
+    seen: set[tuple[str, int, int]] = set()
+    for modname, ctx in graph.modules.items():
+        for fn, static in ctx.jit_bodies:
+            fid = node_to_fid.get(id(fn))
+            if fid is None:
+                continue
+            traced = _param_names(fn) - static
+            for e in graph.out_edges.get(fid, ()):
+                if e.kind != "call" or not isinstance(e.node, ast.Call):
+                    continue
+                helper = graph.functions.get(e.callee)
+                if helper is None or helper.qualpath == "<module>" \
+                        or id(helper.node) in jit_nodes:
+                    continue
+                call = e.node
+                hargs = helper.node.args
+                positional = [p.arg for p in (*hargs.posonlyargs,
+                                              *hargs.args)]
+                tainted: set[str] = set()
+                for i, arg in enumerate(call.args):
+                    if i < len(positional) and \
+                            _rooted_at_param(arg, traced):
+                        tainted.add(positional[i])
+                for kw in call.keywords:
+                    if kw.arg and _rooted_at_param(kw.value, traced):
+                        tainted.add(kw.arg)
+                tainted -= {"self", "cls"}
+                if not tainted:
+                    continue
+                hctx = graph.modules[helper.modname]
+                for n in _own_body_walk(helper.node):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    q = hctx.qual(n.func)
+                    bad = None
+                    if q in _HOST_FETCH_CALLS and n.args and \
+                            _rooted_at_param(n.args[0], tainted):
+                        bad = f"{q}()"
+                    elif isinstance(n.func, ast.Attribute) and \
+                            n.func.attr in _HOST_FETCH_METHODS and \
+                            _rooted_at_param(n.func.value, tainted):
+                        bad = f".{n.func.attr}()"
+                    elif isinstance(n.func, ast.Name) and \
+                            n.func.id in _TRACER_COERCIONS and \
+                            n.func.id not in hctx.aliases and n.args \
+                            and _rooted_at_param(n.args[0], tainted):
+                        bad = f"{n.func.id}()"
+                    if bad is None:
+                        continue
+                    key = (helper.fid, n.lineno, n.col_offset)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield _site_finding(
+                        graph, helper.fid, n, "host-sync-in-jit-helper",
+                        f"{bad} in helper `{_short(helper.fid)}` "
+                        f"fetches a traced value to host — the helper "
+                        f"is called from jitted `{fn.name}` "
+                        f"({ctx.rel_path}:{call.lineno}) with a traced "
+                        "argument; keep the value in jnp or hoist the "
+                        "fetch out of the compiled step")

@@ -27,6 +27,10 @@ import re
 import tokenize
 from typing import Iterable, Iterator
 
+from imagent_tpu.analysis.graph import ProjectGraph
+from imagent_tpu.analysis.podrules import (DEFAULT_MANIFEST,
+                                           PROJECT_RULES, PodlintConfig,
+                                           run_project_rules)
 from imagent_tpu.analysis.rules import RULES, Finding, ModuleContext
 
 _SUPPRESS_RE = re.compile(
@@ -120,35 +124,62 @@ def load_baseline(path: str) -> list[dict]:
                     f"{path}: entry {i} needs a non-empty {field!r} "
                     "(every grandfathered finding carries its "
                     "justification)")
-        if e["rule"] not in RULES:
+        if e["rule"] not in RULES and e["rule"] not in PROJECT_RULES:
             raise ValueError(
                 f"{path}: entry {i} names unknown rule {e['rule']!r}")
     return entries
 
 
-def lint_file(path: str, rel_path: str,
-              select: set[str] | None = None
-              ) -> tuple[list[Finding], int, list[int]]:
-    """(actionable findings, suppressed count, unused-suppression
-    lines) for one file.  Syntax errors surface as a finding on the
-    offending line rather than crashing the whole run.
+@dataclasses.dataclass
+class _ParsedFile:
+    """One file, parsed exactly once: the per-module pass, the project
+    graph, and the suppression pass all share this."""
+    path: str
+    rel: str
+    source: str
+    ctx: ModuleContext | None          # None on syntax error
+    error: Finding | None
 
-    A suppression applies to any finding whose statement extent
-    ``[line, end_line]`` covers the comment's line, so the idiomatic
-    placement at the END of a multiline call works."""
+
+def _parse_file(path: str, rel_path: str) -> _ParsedFile:
     with open(path, encoding="utf-8") as f:
         source = f.read()
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
-        return [Finding(rel_path, e.lineno or 1, e.offset or 0,
-                        "syntax-error", f"cannot parse: {e.msg}")], 0, []
-    ctx = ModuleContext(rel_path, source, tree)
+        return _ParsedFile(
+            path, rel_path, source, None,
+            Finding(rel_path, e.lineno or 1, e.offset or 0,
+                    "syntax-error", f"cannot parse: {e.msg}"))
+    return _ParsedFile(path, rel_path, source,
+                       ModuleContext(rel_path, source, tree), None)
+
+
+def _module_findings(ctx: ModuleContext,
+                     select: set[str] | None) -> list[Finding]:
     raw: list[Finding] = []
     for name, rule in RULES.items():
         if select is not None and name not in select:
             continue
         raw.extend(rule.check(ctx))
+    return raw
+
+
+def _podlint_config(manifest_path: str | None) -> PodlintConfig:
+    return PodlintConfig(
+        manifest_path=manifest_path or DEFAULT_MANIFEST)
+
+
+def _apply_suppressions(
+        source: str, rel_path: str, raw: list[Finding],
+        select: set[str] | None
+) -> tuple[list[Finding], int, list[int]]:
+    """Suppression + bare-suppression + unused-suppression pass for
+    one file's combined (module + project) findings.
+
+    A suppression applies to any finding whose statement extent
+    ``[line, end_line]`` covers the comment's line, so the idiomatic
+    placement at the END of a multiline call works."""
     by_line, unjustified = parse_suppressions(source)
     kept: list[Finding] = []
     suppressed = 0
@@ -177,25 +208,68 @@ def lint_file(path: str, rel_path: str,
     return kept, suppressed, unused
 
 
+def lint_file(path: str, rel_path: str,
+              select: set[str] | None = None,
+              manifest_path: str | None = None
+              ) -> tuple[list[Finding], int, list[int]]:
+    """(actionable findings, suppressed count, unused-suppression
+    lines) for one file.  Syntax errors surface as a finding on the
+    offending line rather than crashing the whole run.
+
+    The interprocedural rules run too, over a one-module project —
+    cross-module behaviour needs ``run_paths`` on a directory."""
+    pf = _parse_file(path, rel_path)
+    if pf.ctx is None:
+        return [pf.error], 0, []
+    raw = _module_findings(pf.ctx, select)
+    graph = ProjectGraph([pf.ctx])
+    raw.extend(run_project_rules(graph, select,
+                                 _podlint_config(manifest_path)))
+    return _apply_suppressions(pf.source, rel_path, raw, select)
+
+
 def run_paths(paths: Iterable[str], baseline_path: str | None = None,
               select: set[str] | None = None,
-              root: str | None = None) -> LintResult:
-    """Lint every .py under ``paths``; apply suppressions + baseline."""
+              root: str | None = None,
+              manifest_path: str | None = None) -> LintResult:
+    """Lint every .py under ``paths``: per-module rules, then the
+    interprocedural podlint pass over the whole parsed set, then
+    suppressions + baseline on the merged findings.  Each file is
+    parsed exactly once."""
     root = root or os.getcwd()
     baseline = load_baseline(baseline_path) if baseline_path and \
         os.path.exists(baseline_path) else []
+    parsed: list[_ParsedFile] = []
+    for path in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), root)
+        rel = rel.replace(os.sep, "/")
+        parsed.append(_parse_file(path, rel))
+
+    raw_by_rel: dict[str, list[Finding]] = {}
+    for pf in parsed:
+        if pf.ctx is None:
+            raw_by_rel.setdefault(pf.rel, []).append(pf.error)
+        else:
+            raw_by_rel.setdefault(pf.rel, []).extend(
+                _module_findings(pf.ctx, select))
+    graph = ProjectGraph([pf.ctx for pf in parsed if pf.ctx])
+    for f_ in run_project_rules(graph, select,
+                                _podlint_config(manifest_path)):
+        raw_by_rel.setdefault(f_.path, []).append(f_)
+
     matched: set[int] = set()
     findings: list[Finding] = []
     unused_supp: list[tuple[str, int]] = []
     suppressed = 0
-    n_files = 0
-    for path in iter_py_files(paths):
-        rel = os.path.relpath(os.path.abspath(path), root)
-        rel = rel.replace(os.sep, "/")
-        n_files += 1
-        kept, supp, unused = lint_file(path, rel, select)
+    for pf in parsed:
+        raw = raw_by_rel.get(pf.rel, [])
+        if pf.ctx is None:
+            kept, supp, unused = raw, 0, []
+        else:
+            kept, supp, unused = _apply_suppressions(
+                pf.source, pf.rel, raw, select)
         suppressed += supp
-        unused_supp.extend((rel, ln) for ln in sorted(unused))
+        unused_supp.extend((pf.rel, ln) for ln in sorted(unused))
         for f_ in kept:
             hit = next(
                 (i for i, e in enumerate(baseline)
@@ -208,7 +282,7 @@ def run_paths(paths: Iterable[str], baseline_path: str | None = None,
                 findings.append(f_)
     stale = [e for i, e in enumerate(baseline) if i not in matched]
     return LintResult(findings, suppressed, len(matched), stale,
-                      n_files, unused_supp)
+                      len(parsed), unused_supp)
 
 
 def write_baseline(result: LintResult, path: str,
@@ -229,7 +303,7 @@ def write_baseline(result: LintResult, path: str,
     entries = []
     skipped = 0
     for f_ in result.findings:
-        if f_.rule not in RULES:
+        if f_.rule not in RULES and f_.rule not in PROJECT_RULES:
             skipped += 1
             continue
         entries.append({

@@ -39,42 +39,6 @@ _REPO = os.path.dirname(_DIR)
 # ---------------------------------------------------------------------------
 
 
-def test_shardfmt_is_jax_free():
-    """The sharded format module must never import jax: everything the
-    committer threads and the degraded-pod salvage execute lives there,
-    so the collective-free contract holds by construction (the
-    ``elastic.py`` audit pattern, exercised end-to-end: the subprocess
-    also runs a real write/assemble/restore cycle first)."""
-    src = os.path.join(_REPO, "imagent_tpu", "shardfmt.py")
-    with open(src) as f:
-        assert "import jax" not in f.read()
-    code = (
-        "import sys, numpy as np, tempfile, os\n"
-        "from imagent_tpu import shardfmt\n"
-        "d = tempfile.mkdtemp()\n"
-        "gen = {'epoch': 0, 'resume_step': 0}\n"
-        "a = np.arange(12, dtype=np.float32).reshape(3, 4)\n"
-        "e0 = [{'key': '.p', 'dtype': 'float32', 'shape': [3, 4],\n"
-        "       'windows': [((0, 0), (2, 4), a[:2])]}]\n"
-        "e1 = [{'key': '.p', 'dtype': 'float32', 'shape': [3, 4],\n"
-        "       'windows': [((2, 0), (3, 4), a[2:])]}]\n"
-        "shardfmt.write_shard(d, 0, e0, gen)\n"
-        "shardfmt.write_shard(d, 1, e1, gen)\n"
-        "got, missing = shardfmt.collect_shards(d, [0, 1], gen)\n"
-        "assert not missing\n"
-        "man = shardfmt.assemble_manifest(d, got, {'epoch': 0})\n"
-        "out = shardfmt.restore_arrays(d, man)\n"
-        "assert np.array_equal(out['.p'], a)\n"
-        "bad = [m for m in sys.modules if m == 'jax'"
-        " or m.startswith('jax.')]\n"
-        "sys.exit(1 if bad else 0)\n"
-    )
-    proc = subprocess.run([sys.executable, "-c", code], cwd=_REPO,
-                          env=clean_env(), capture_output=True,
-                          text=True)
-    assert proc.returncode == 0, proc.stdout + proc.stderr
-
-
 def test_shard_roundtrip_scalars_and_bf16(tmp_path):
     """0-d leaves, bf16 windows, and empty window lists all round-trip
     through the per-rank files and the manifest."""

@@ -1,0 +1,114 @@
+"""Consolidated, manifest-driven jax-free contract.
+
+One source of truth — ``imagent_tpu/analysis/jaxfree.json``, the same
+manifest the ``jax-free-violation`` podlint rule enforces statically —
+replaces the per-test-file source greps and per-module subprocess
+asserts that used to be scattered across test_trace/test_health/
+test_telemetry/test_slo/test_groups/test_elastic/test_pod_failure/
+test_ckpt_sharded/test_stream.  Two layers:
+
+* a parametrized AST check that none of the declared modules contains
+  a jax/jaxlib import statement at all — stricter than the static
+  rule, which sanctions function-scope lazy imports (modules listed
+  under ``lazy_ok`` in the manifest get only the lazy allowance);
+* ONE subprocess that imports every declared module in manifest order
+  and fails on the first one that drags jax into ``sys.modules`` —
+  the runtime proof, with a tenth of the subprocess spawns the old
+  per-file asserts paid.
+
+Why the contract matters: these modules run exactly when a device
+handle would be fatal — per-step telemetry and health (a handle is a
+possible sync), the deadman/heartbeat fatal-exit path (runs while
+collectives hang), committer threads and degraded-pod salvage, the
+pre-init rendezvous, accelerator-less decode hosts, and CI boxes with
+no JAX stack (the analysis package itself).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_MANIFEST_PATH = os.path.join(REPO, "imagent_tpu", "analysis",
+                              "jaxfree.json")
+with open(_MANIFEST_PATH) as _f:
+    _MANIFEST = json.load(_f)
+MODULES: list[str] = _MANIFEST["modules"]
+LAZY_OK: set[str] = set(_MANIFEST.get("lazy_ok", ()))
+
+
+def _module_file(mod: str) -> str:
+    base = os.path.join(REPO, mod.replace(".", os.sep))
+    if os.path.isfile(base + ".py"):
+        return base + ".py"
+    return os.path.join(base, "__init__.py")
+
+
+def _jax_import_lines(path: str, top_level_only: bool) -> list[int]:
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    hits: list[int] = []
+
+    def walk(node: ast.AST, top: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            in_fn = isinstance(child, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.Lambda))
+            if isinstance(child, ast.Import):
+                roots = [a.name.split(".")[0] for a in child.names]
+            elif isinstance(child, ast.ImportFrom):
+                roots = [(child.module or "").split(".")[0]]
+            else:
+                walk(child, top and not in_fn)
+                continue
+            if any(r in ("jax", "jaxlib") for r in roots) and \
+                    (top or not top_level_only):
+                hits.append(child.lineno)
+
+    walk(tree, True)
+    return hits
+
+
+@pytest.mark.parametrize("mod", MODULES)
+def test_declared_module_has_no_jax_import_statement(mod):
+    """No jax import, even lazy (no device handles -> no possible
+    sync).  Modules in the manifest's ``lazy_ok`` list keep only the
+    top-level ban — the function-scope import is the sanctioned
+    escape hatch the static rule also honors."""
+    lines = _jax_import_lines(_module_file(mod),
+                              top_level_only=mod in LAZY_OK)
+    assert not lines, (
+        f"{mod} is declared jax-free in analysis/jaxfree.json but "
+        f"imports jax at line(s) {lines}; make it lazy AND add the "
+        "module to the manifest's 'lazy_ok' list only if the module "
+        "genuinely needs jax off the no-device path")
+
+
+def test_declared_modules_import_without_pulling_jax():
+    """The runtime proof, one subprocess for the whole manifest: each
+    module imports cleanly and jax never enters sys.modules.  Also
+    the staleness check — a deleted module fails its import here."""
+    code = (
+        "import sys\n"
+        f"mods = {MODULES!r}\n"
+        "for m in mods:\n"
+        "    __import__(m)\n"
+        "    bad = sorted(x for x in sys.modules\n"
+        "                 if x.split('.')[0] in ('jax', 'jaxlib'))\n"
+        "    if bad:\n"
+        "        print('jax leaked after importing', m, ':', bad[:3])\n"
+        "        sys.exit(1)\n"
+        "print('OK', len(mods))\n")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PYTEST", "JAX_"))}
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          env=env, capture_output=True, text=True,
+                          timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert f"OK {len(MODULES)}" in proc.stdout

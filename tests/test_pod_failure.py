@@ -71,21 +71,6 @@ def test_fatal_errors_carry_their_codes():
         assert exc.exit_code == code and exc.reason == reason
 
 
-def test_heartbeat_and_deadman_are_jax_free():
-    """Same contract as the telemetry sampler: the out-of-band layer
-    must keep functioning when every device queue is wedged, and must
-    never be able to add a device sync to the step loop."""
-    import imagent_tpu.resilience.deadman as dm
-    import imagent_tpu.resilience.exitcodes as ec
-    import imagent_tpu.resilience.heartbeat as hb
-    for mod in (hb, dm, ec):
-        with open(mod.__file__) as f:
-            src = f.read()
-        assert "import jax" not in src, (
-            f"{mod.__name__} must stay jax-free (no device handles -> "
-            "no possible sync, works while collectives hang)")
-
-
 # ---------------------------------------------------------------------------
 # Heartbeat writer
 # ---------------------------------------------------------------------------

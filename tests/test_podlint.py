@@ -1,0 +1,675 @@
+"""podlint (imagent_tpu/analysis/graph.py + podrules.py) tests.
+
+Graph-builder units (import cycles, method resolution, partial and
+thread-target edges), bad-fires/good-silent fixture pairs for each of
+the five interprocedural rules, one historical-bug regression fixture
+per rule (each reproduces a defect a past PR fixed by hand review —
+the exact class podlint now catches mechanically), and the
+machine-readable CLI output.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+from imagent_tpu.analysis import run_paths
+from imagent_tpu.analysis.graph import ProjectGraph, module_name
+from imagent_tpu.analysis.podrules import PROJECT_RULES
+from imagent_tpu.analysis.runner import _parse_file
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_graph(tmp_path, files: dict[str, str]) -> ProjectGraph:
+    ctxs = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        pf = _parse_file(str(p), rel)
+        assert pf.ctx is not None, f"fixture {rel} does not parse"
+        ctxs.append(pf.ctx)
+    return ProjectGraph(ctxs)
+
+
+def lint_tree(tmp_path, files: dict[str, str], select=None,
+              manifest: dict | None = None):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    manifest_path = None
+    if manifest is not None:
+        mp = tmp_path / "jaxfree.json"
+        mp.write_text(json.dumps(manifest))
+        manifest_path = str(mp)
+    result = run_paths([str(tmp_path)], root=str(tmp_path),
+                       select=select, manifest_path=manifest_path)
+    return result.findings
+
+
+def rules_fired(findings):
+    return {f.rule for f in findings}
+
+
+def test_registry_has_all_five_project_rules():
+    assert set(PROJECT_RULES) == {
+        "ungated-collective", "asymmetric-collective",
+        "collective-in-thread", "jax-free-violation",
+        "host-sync-in-jit-helper"}
+    for r in PROJECT_RULES.values():
+        assert r.doc
+
+
+# ------------------------------------------------------- graph builder
+
+
+def test_module_name_mapping():
+    assert module_name("imagent_tpu/data/stream.py") == \
+        "imagent_tpu.data.stream"
+    assert module_name("imagent_tpu/analysis/__init__.py") == \
+        "imagent_tpu.analysis"
+    assert module_name("bench.py") == "bench"
+
+
+def test_import_cycle_closure_terminates(tmp_path):
+    g = build_graph(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/a.py": "import pkg.b\nX = 1\n",
+        "pkg/b.py": "import pkg.a\nY = 2\n",
+    })
+    closure = g.import_closure("pkg.a")
+    assert "pkg.b" in closure and "pkg.a" in closure
+    # chains start at the declared module
+    assert closure["pkg.b"][0] == "pkg.a"
+
+
+def test_method_resolution_through_base_class(tmp_path):
+    g = build_graph(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "class Base:\n"
+            "    def helper_method_xy(self):\n"
+            "        pass\n"
+            "class C(Base):\n"
+            "    def f(self):\n"
+            "        self.helper_method_xy()\n"),
+    })
+    callees = {e.callee for e in g.out_edges.get("pkg.m:C.f", ())}
+    assert "pkg.m:Base.helper_method_xy" in callees
+
+
+def test_cross_module_alias_call_edge(tmp_path):
+    g = build_graph(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/util.py": "def f():\n    pass\n",
+        "pkg/m.py": "import pkg.util as u\n\ndef g():\n    u.f()\n",
+    })
+    callees = {e.callee for e in g.out_edges.get("pkg.m:g", ())}
+    assert "pkg.util:f" in callees
+
+
+def test_partial_and_callback_ref_edges(tmp_path):
+    g = build_graph(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "import functools\n"
+            "def worker(n):\n"
+            "    return n\n"
+            "def launch(reg):\n"
+            "    reg(functools.partial(worker, 1))\n"),
+    })
+    refs = [e for e in g.out_edges.get("pkg.m:launch", ())
+            if e.kind == "ref" and e.callee == "pkg.m:worker"]
+    assert refs, "partial(worker, ...) should add a ref edge"
+
+
+def test_thread_target_entries_fn_and_method(tmp_path):
+    g = build_graph(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "import threading\n"
+            "def bg():\n"
+            "    pass\n"
+            "class W:\n"
+            "    def _run_loop(self):\n"
+            "        pass\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._run_loop).start()\n"
+            "def go():\n"
+            "    threading.Thread(target=bg, daemon=True).start()\n"),
+    })
+    entries = {t.fid for t in g.thread_entries}
+    assert "pkg.m:bg" in entries
+    assert "pkg.m:W._run_loop" in entries
+
+
+def test_add_monitor_factory_closure_entry(tmp_path):
+    g = build_graph(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "def commit_monitor(deadline):\n"
+            "    def check(now):\n"
+            "        return now < deadline\n"
+            "    return check\n"
+            "def wire(watchdog):\n"
+            "    watchdog.add_monitor(commit_monitor(30.0))\n"),
+    })
+    entries = {t.fid for t in g.thread_entries}
+    assert "pkg.m:commit_monitor.<locals>.check" in entries
+
+
+def test_unique_method_fallback_respects_denylist(tmp_path):
+    g = build_graph(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "class Only:\n"
+            "    def very_unusual_method(self):\n"
+            "        pass\n"
+            "    def get(self):\n"
+            "        pass\n"
+            "def f(obj, q):\n"
+            "    obj.very_unusual_method()\n"
+            "    q.get()\n"),
+    })
+    callees = {e.callee for e in g.out_edges.get("pkg.m:f", ())}
+    assert "pkg.m:Only.very_unusual_method" in callees
+    # 'get' is on the common-name denylist: stdlib queues/dicts must
+    # not be wired into the project call graph.
+    assert "pkg.m:Only.get" not in callees
+
+
+def test_local_type_inference_binds_method(tmp_path):
+    g = build_graph(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "class Writer:\n"
+            "    def commit_now(self):\n"
+            "        pass\n"
+            "class Other:\n"
+            "    def commit_now(self):\n"
+            "        pass\n"
+            "def f():\n"
+            "    w = Writer()\n"
+            "    w.commit_now()\n"),
+    })
+    # two classes define commit_now, so only type inference can bind it
+    callees = {e.callee for e in g.out_edges.get("pkg.m:f", ())}
+    assert "pkg.m:Writer.commit_now" in callees
+
+
+# ------------------------------------------------- ungated-collective
+
+
+UNGATED_BAD = {
+    "pkg/__init__.py": "",
+    "pkg/ckpt.py": (
+        "from jax.experimental import multihost_utils\n"
+        "def commit_barrier(tag):\n"
+        "    multihost_utils.sync_global_devices(tag)\n"
+        "def save():\n"
+        "    commit_barrier('commit')\n"),
+}
+
+UNGATED_GOOD_LOCAL = {
+    "pkg/__init__.py": "",
+    "pkg/deadman.py": "def raise_if_degraded():\n    pass\n",
+    "pkg/ckpt.py": (
+        "from jax.experimental import multihost_utils\n"
+        "from pkg import deadman\n"
+        "def commit_barrier(tag):\n"
+        "    deadman.raise_if_degraded()\n"
+        "    multihost_utils.sync_global_devices(tag)\n"
+        "def save():\n"
+        "    commit_barrier('commit')\n"),
+}
+
+UNGATED_GOOD_CALLER = {
+    "pkg/__init__.py": "",
+    "pkg/deadman.py": "def raise_if_degraded():\n    pass\n",
+    "pkg/ckpt.py": (
+        "from jax.experimental import multihost_utils\n"
+        "from pkg import deadman\n"
+        "def commit_barrier(tag):\n"
+        "    multihost_utils.sync_global_devices(tag)\n"
+        "def save():\n"
+        "    deadman.raise_if_degraded()\n"
+        "    commit_barrier('commit')\n"),
+}
+
+
+def test_ungated_collective_fires_across_modules(tmp_path):
+    findings = lint_tree(tmp_path, UNGATED_BAD,
+                         select={"ungated-collective"})
+    assert rules_fired(findings) == {"ungated-collective"}
+    (f,) = findings
+    assert "sync_global_devices" in f.message
+    assert "ckpt:save" in f.message  # the example ungated path
+
+
+def test_ungated_collective_silent_with_local_gate(tmp_path):
+    assert lint_tree(tmp_path, UNGATED_GOOD_LOCAL,
+                     select={"ungated-collective"}) == []
+
+
+def test_ungated_collective_silent_when_every_caller_gates(tmp_path):
+    assert lint_tree(tmp_path, UNGATED_GOOD_CALLER,
+                     select={"ungated-collective"}) == []
+
+
+def test_ungated_collective_sees_gateway_attr_on_call(tmp_path):
+    # checkpoint.py's `_multihost().sync_global_devices(...)` idiom:
+    # the collective is an attribute on a call result, not on a name.
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/ckpt.py": (
+            "def _multihost():\n"
+            "    from jax.experimental import multihost_utils\n"
+            "    return multihost_utils\n"
+            "def save():\n"
+            "    _multihost().sync_global_devices('x')\n"),
+    }
+    findings = lint_tree(tmp_path, files,
+                         select={"ungated-collective"})
+    assert rules_fired(findings) == {"ungated-collective"}
+
+
+def test_regression_pre_pr7_unguarded_checkpoint_commit(tmp_path):
+    """Historical bug: before the deadman landed, the checkpoint
+    commit barrier ran with no degraded-pod gate anywhere on the path
+    — a dead peer left every survivor wedged in the barrier.  PR 7
+    fixed it by hand audit; the rule now finds the shape statically."""
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/ckpt.py": (
+            "from jax.experimental import multihost_utils\n"
+            "def _commit(path):\n"
+            "    multihost_utils.sync_global_devices('ckpt:' + path)\n"),
+        "pkg/engine.py": (
+            "from pkg import ckpt\n"
+            "def run_epoch():\n"
+            "    ckpt._commit('/tmp/step')\n"),
+    }
+    findings = lint_tree(tmp_path, files,
+                         select={"ungated-collective"})
+    assert len(findings) == 1
+    assert findings[0].path == "pkg/ckpt.py"
+
+
+def test_regression_pr4_per_step_assert_equal(tmp_path):
+    """Historical bug: a per-step ``assert_equal`` safety broadcast in
+    the hot loop (racing in-flight psums) — PR 4 removed it.  The
+    broadcast was both per-step overhead and ungated."""
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/train.py": (
+            "from jax.experimental import multihost_utils\n"
+            "def _check_sync(state):\n"
+            "    multihost_utils.assert_equal(state, 'step parity')\n"
+            "def train_one_epoch(steps, state):\n"
+            "    for _ in range(steps):\n"
+            "        _check_sync(state)\n"),
+    }
+    findings = lint_tree(tmp_path, files,
+                         select={"ungated-collective"})
+    assert len(findings) == 1
+    assert "assert_equal" in findings[0].message
+
+
+# ----------------------------------------------- asymmetric-collective
+
+
+def test_asymmetric_collective_fires_under_rank_branch(tmp_path):
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "import jax\n"
+            "from jax.experimental import multihost_utils\n"
+            "def deadman_gate():\n"
+            "    raise_if_degraded = None\n"
+            "def publish(verdict):\n"
+            "    if jax.process_index() == 0:\n"
+            "        multihost_utils.broadcast_one_to_all(verdict)\n"),
+    }
+    findings = lint_tree(tmp_path, files,
+                         select={"asymmetric-collective"})
+    assert rules_fired(findings) == {"asymmetric-collective"}
+
+
+def test_asymmetric_collective_silent_with_counterpart(tmp_path):
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "import jax\n"
+            "from jax.experimental import multihost_utils\n"
+            "def publish(verdict):\n"
+            "    if jax.process_index() == 0:\n"
+            "        out = multihost_utils.broadcast_one_to_all("
+            "verdict)\n"
+            "    else:\n"
+            "        out = multihost_utils.broadcast_one_to_all(None)\n"
+            "    return out\n"),
+    }
+    assert lint_tree(tmp_path, files,
+                     select={"asymmetric-collective"}) == []
+
+
+def test_asymmetric_collective_silent_under_world_size_branch(tmp_path):
+    # process_count() is identical on every rank: not a rank condition.
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "import jax\n"
+            "from jax.experimental import multihost_utils\n"
+            "def publish(v):\n"
+            "    if jax.process_count() > 1:\n"
+            "        multihost_utils.broadcast_one_to_all(v)\n"),
+    }
+    assert lint_tree(tmp_path, files,
+                     select={"asymmetric-collective"}) == []
+
+
+def test_asymmetric_collective_after_rank_early_return(tmp_path):
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "from jax.experimental import multihost_utils\n"
+            "def export(is_master, params):\n"
+            "    if not is_master:\n"
+            "        return None\n"
+            "    return multihost_utils.process_allgather(params)\n"),
+    }
+    findings = lint_tree(tmp_path, files,
+                         select={"asymmetric-collective"})
+    assert len(findings) == 1
+    assert "early return" in findings[0].message
+
+
+def test_regression_pr5_rank_asymmetric_commit_verdict(tmp_path):
+    """Historical bug: the async-commit verdict was computed on the
+    master only, and the master alone entered the broadcast — the
+    other ranks sat in the NEXT collective while the master waited in
+    this one (split brain).  PR 5 replaced it with a pod-agreed poll;
+    the rule recognizes the shape, including through a wrapper."""
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/ckpt.py": (
+            "import jax\n"
+            "from jax.experimental import multihost_utils\n"
+            "def _announce(ok):\n"
+            "    multihost_utils.broadcast_one_to_all(ok)\n"
+            "def poll_async(pending):\n"
+            "    if jax.process_index() == 0:\n"
+            "        _announce(bool(pending))\n"),
+    }
+    findings = lint_tree(tmp_path, files,
+                         select={"asymmetric-collective"})
+    assert len(findings) == 1
+    assert "collective-reaching" in findings[0].message
+
+
+# ------------------------------------------------ collective-in-thread
+
+
+def test_collective_in_thread_fires_through_chain(tmp_path):
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/ckpt.py": (
+            "import threading\n"
+            "from jax.experimental import multihost_utils\n"
+            "def _pod_agree(v):\n"
+            "    return multihost_utils.process_allgather(v)\n"
+            "def _commit_worker():\n"
+            "    _pod_agree(1)\n"
+            "def save_async():\n"
+            "    threading.Thread(target=_commit_worker).start()\n"),
+    }
+    findings = lint_tree(tmp_path, files,
+                         select={"collective-in-thread"})
+    assert len(findings) == 1
+    assert "_commit_worker" in findings[0].message
+
+
+def test_collective_in_thread_silent_for_clean_thread(tmp_path):
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "import threading\n"
+            "from jax.experimental import multihost_utils\n"
+            "def writer():\n"
+            "    pass\n"
+            "def main_path(v):\n"
+            "    multihost_utils.process_allgather(v)\n"
+            "def start():\n"
+            "    threading.Thread(target=writer).start()\n"),
+    }
+    assert lint_tree(tmp_path, files,
+                     select={"collective-in-thread"}) == []
+
+
+def test_regression_pr14_committer_thread_collective(tmp_path):
+    """Historical near-miss: the sharded committer thread calling back
+    into a pod-agreement wrapper — PR 14 added a runtime fence that
+    raises; this is the static complement, firing on the registered
+    monitor entry point too."""
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/ckpt.py": (
+            "from jax.experimental import multihost_utils\n"
+            "def commit_monitor(deadline):\n"
+            "    def check(now):\n"
+            "        multihost_utils.process_allgather(now)\n"
+            "    return check\n"
+            "def wire(watchdog):\n"
+            "    watchdog.add_monitor(commit_monitor(30.0))\n"),
+    }
+    findings = lint_tree(tmp_path, files,
+                         select={"collective-in-thread"})
+    assert len(findings) == 1
+    assert "monitor" in findings[0].message
+
+
+# -------------------------------------------------- jax-free-violation
+
+
+def test_jax_free_violation_direct_and_transitive(tmp_path):
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/helper.py": "import jax.numpy as jnp\nX = 1\n",
+        "pkg/contract.py": "import pkg.helper\nY = 2\n",
+    }
+    findings = lint_tree(tmp_path, files,
+                         select={"jax-free-violation"},
+                         manifest={"modules": ["pkg.contract"]})
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path == "pkg/helper.py"  # anchored at the actual import
+    assert "pkg.contract -> pkg.helper -> jax.numpy" in f.message
+
+
+def test_jax_free_violation_lazy_import_is_sanctioned(tmp_path):
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/contract.py": (
+            "def to_device(batch):\n"
+            "    import jax\n"
+            "    return jax.device_put(batch)\n"),
+    }
+    assert lint_tree(tmp_path, files,
+                     select={"jax-free-violation"},
+                     manifest={"modules": ["pkg.contract"]}) == []
+
+
+def test_jax_free_violation_skips_absent_manifest_entries(tmp_path):
+    files = {"pkg/__init__.py": "", "pkg/a.py": "import jax\n"}
+    # 'pkg.gone' is not in the tree: the consolidated import test owns
+    # staleness; the static rule must not crash or fire.
+    assert lint_tree(tmp_path, files,
+                     select={"jax-free-violation"},
+                     manifest={"modules": ["pkg.gone"]}) == []
+
+
+def test_regression_prefetch_style_top_level_jax_import(tmp_path):
+    """Historical bug shape: the host-side data chain importing jax at
+    module scope — a multi-second import plus a device registry on
+    decode hosts that have neither.  Fixed by making the import lazy;
+    the manifest now pins the whole chain."""
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/prefetch.py": (
+            "import jax\n"
+            "def stage(batch):\n"
+            "    return jax.device_put(batch)\n"),
+        "pkg/stream.py": "import pkg.prefetch\n",
+    }
+    findings = lint_tree(tmp_path, files,
+                         select={"jax-free-violation"},
+                         manifest={"modules": ["pkg.stream"]})
+    assert len(findings) == 1
+    assert findings[0].path == "pkg/prefetch.py"
+
+
+# --------------------------------------------- host-sync-in-jit-helper
+
+
+HELPER_BAD = {
+    "pkg/__init__.py": "",
+    "pkg/train.py": (
+        "import jax\n"
+        "import numpy as np\n"
+        "def _log_loss(loss):\n"
+        "    return float(np.asarray(loss))\n"
+        "def make_step():\n"
+        "    @jax.jit\n"
+        "    def step(state, batch):\n"
+        "        _log_loss(state)\n"
+        "        return state + batch\n"
+        "    return step\n"),
+}
+
+
+def test_host_sync_in_jit_helper_fires_one_level_deep(tmp_path):
+    findings = lint_tree(tmp_path, HELPER_BAD,
+                         select={"host-sync-in-jit-helper"})
+    assert len(findings) == 1
+    f = findings[0]
+    assert "numpy.asarray" in f.message and "step" in f.message
+    assert f.line == 4  # anchored at the helper's fetch, not the call
+
+
+def test_host_sync_in_jit_helper_silent_without_traced_arg(tmp_path):
+    # Trace-time numpy on static Python values is idiomatic and legal.
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/train.py": (
+            "import jax\n"
+            "import numpy as np\n"
+            "def _table(n):\n"
+            "    return np.asarray(range(n))\n"
+            "def make_step(width):\n"
+            "    @jax.jit\n"
+            "    def step(state):\n"
+            "        _table(width)\n"
+            "        return state\n"
+            "    return step\n"),
+    }
+    assert lint_tree(tmp_path, files,
+                     select={"host-sync-in-jit-helper"}) == []
+
+
+def test_regression_documented_blind_spot_helper_item(tmp_path):
+    """The exact sentence docs/STATIC_ANALYSIS.md used to carry as a
+    known blind spot: 'a host sync inside a helper *called from* a jit
+    body ... is not seen.'  Now it is."""
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/train.py": (
+            "import jax\n"
+            "def _scalar(metric):\n"
+            "    return metric.item()\n"
+            "def make_step():\n"
+            "    @jax.jit\n"
+            "    def step(state):\n"
+            "        _scalar(state)\n"
+            "        return state\n"
+            "    return step\n"),
+    }
+    findings = lint_tree(tmp_path, files,
+                         select={"host-sync-in-jit-helper"})
+    assert len(findings) == 1
+    assert ".item()" in findings[0].message
+
+
+# -------------------------------------- suppressions and the CI gate
+
+
+def test_project_findings_honor_suppressions(tmp_path):
+    files = dict(UNGATED_BAD)
+    files["pkg/ckpt.py"] = files["pkg/ckpt.py"].replace(
+        "    multihost_utils.sync_global_devices(tag)\n",
+        "    multihost_utils.sync_global_devices(tag)"
+        "  # jaxlint: disable=ungated-collective -- fixture: test\n")
+    result_findings = lint_tree(tmp_path, files)
+    assert "ungated-collective" not in rules_fired(result_findings)
+
+
+def test_cli_format_json_schema(tmp_path):
+    for rel, src in UNGATED_BAD.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    proc = subprocess.run(
+        [sys.executable, "-m", "imagent_tpu.analysis", "pkg",
+         "--no-baseline", "--format", "json"],
+        cwd=str(tmp_path), capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT})
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["format_version"] == 1
+    assert doc["ok"] is False
+    assert doc["files_checked"] == 2
+    (f,) = doc["findings"]
+    assert set(f) == {"path", "line", "col", "rule", "message", "code"}
+    assert f["rule"] == "ungated-collective"
+    assert f["path"] == "pkg/ckpt.py"
+
+
+def test_cli_format_json_clean_is_ok(tmp_path):
+    (tmp_path / "clean.py").write_text("X = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "imagent_tpu.analysis", "clean.py",
+         "--no-baseline", "--format", "json"],
+        cwd=str(tmp_path), capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT})
+    assert proc.returncode == 0
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True and doc["findings"] == []
+
+
+def test_cli_list_rules_includes_podlint():
+    proc = subprocess.run(
+        [sys.executable, "-m", "imagent_tpu.analysis", "--list-rules"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    for name in PROJECT_RULES:
+        assert name in proc.stdout
+
+
+def test_shipped_manifest_modules_exist_in_tree():
+    """Every manifest entry points at a real module file — the static
+    rule skips absent entries by design, so this is the tier-1 check
+    that keeps the manifest honest without a subprocess."""
+    mp = os.path.join(REPO_ROOT, "imagent_tpu", "analysis",
+                      "jaxfree.json")
+    with open(mp) as f:
+        manifest = json.load(f)
+    assert manifest["modules"] == sorted(set(manifest["modules"]))
+    for mod in manifest["modules"]:
+        rel = mod.replace(".", os.sep)
+        assert os.path.exists(os.path.join(REPO_ROOT, rel + ".py")) \
+            or os.path.exists(os.path.join(REPO_ROOT, rel,
+                                           "__init__.py")), \
+            f"stale jaxfree.json entry: {mod}"

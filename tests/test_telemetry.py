@@ -4,7 +4,6 @@ the JSONL schema, and the end-to-end acceptance contract — a TRUE
 2-process CPU engine run whose telemetry.jsonl must carry pod-
 aggregated per-host stats with phases summing to >=95% of wall."""
 
-import inspect
 import json
 import os
 import time
@@ -103,17 +102,12 @@ def test_sampler_percentiles_and_ring_wrap():
 
 
 def test_sampler_adds_no_per_step_host_sync():
-    """The acceptance contract's zero-sync assertion, in two parts:
-    (a) the per-step modules are jax-free by construction — they
-    cannot touch a device, so they cannot sync one; (b) the per-step
+    """The acceptance contract's zero-sync assertion: the per-step
     cost is sub-microsecond-scale host arithmetic, bounded loosely
     here so a regression that sneaks real work (allocation, I/O,
-    device access) into the hot path fails loudly."""
-    for mod in (sampler, goodput):
-        src = inspect.getsource(mod)
-        assert "import jax" not in src, (
-            f"{mod.__name__} is on the per-step path and must stay "
-            "jax-free (no device handles -> no possible sync)")
+    device access) into the hot path fails loudly.  (The jax-free
+    half of the contract lives in tests/test_jaxfree.py, driven by
+    the analysis/jaxfree.json manifest.)"""
     s = StepTimeSampler()
     acct = GoodputAccountant()
     acct.begin_epoch()

@@ -28,6 +28,7 @@ import subprocess
 import sys
 
 from mp_launch import clean_env, free_port
+from marginal import retry_marginal
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(_DIR)
@@ -136,40 +137,52 @@ def test_tp_sharded_commit_overlap_salvage_and_resume(tmp_path):
     cross-process train-step psums on BOTH ranks; the abrupt loss of
     rank 1 is salvaged at FULL coverage from rank 0 alone (model axis
     host-local = replica-group layout); the salvage restores onto
-    world 2 AND world 1 with identical parameters."""
-    scratch = str(tmp_path / "drill")
-    os.makedirs(scratch)
-    outs, rcs = _launch_sharded("tp_commit", scratch, 2)
-    assert rcs == [0, 0], "\n".join(outs)
-    assert "EMERGENCY_OK" in outs[0], outs[0]
-    assert "RANK1_GONE" in outs[1], outs[1]
-    # Overlap: every rank dispatched steps INSIDE rank 0's commit
-    # window (the sharded committer was sleeping mid-commit while the
-    # cross-process psums kept flowing).
-    win = [ln for ln in outs[0].splitlines()
-           if ln.startswith("WINDOW")][0].split()
-    w0, w1 = float(win[1]), float(win[2])
-    assert w1 - w0 >= 2.0, win  # the injected slow commit
-    for out in outs:
-        times = [float(x) for ln in out.splitlines()
-                 if ln.startswith("DISPATCHED")
-                 for x in ln.split()[1:]]
-        assert times, out
-        inside = [t for t in times if w0 <= t <= w1]
-        assert inside, (w0, w1, times)
+    world 2 AND world 1 with identical parameters.
 
-    checksums = []
-    outs2, rcs2 = _launch_sharded("tp_resume", scratch, 2)
-    assert rcs2 == [0, 0], "\n".join(outs2)
-    for out in outs2:
-        assert "RESTORED last 1 7 1" in out, out
-        checksums.append([ln for ln in out.splitlines()
-                          if ln.startswith("CHECKSUM")][0])
-    assert checksums[0] == checksums[1], checksums
+    Environment-marginal on the 1-core sandbox: the two-rank gloo
+    rendezvous under the overlapped psums occasionally loses its
+    connection race. Guarded by one loud fresh-scratch retry — see
+    tests/marginal.py."""
+    def attempt(i):
+        scratch = str(tmp_path / f"drill{i}")
+        os.makedirs(scratch)
+        outs, rcs = _launch_sharded("tp_commit", scratch, 2)
+        assert rcs == [0, 0], "\n".join(outs)
+        assert "EMERGENCY_OK" in outs[0], outs[0]
+        assert "RANK1_GONE" in outs[1], outs[1]
+        # Overlap: every rank dispatched steps INSIDE rank 0's commit
+        # window (the sharded committer was sleeping mid-commit while
+        # the cross-process psums kept flowing).
+        win = [ln for ln in outs[0].splitlines()
+               if ln.startswith("WINDOW")][0].split()
+        w0, w1 = float(win[1]), float(win[2])
+        assert w1 - w0 >= 2.0, win  # the injected slow commit
+        for out in outs:
+            times = [float(x) for ln in out.splitlines()
+                     if ln.startswith("DISPATCHED")
+                     for x in ln.split()[1:]]
+            assert times, out
+            inside = [t for t in times if w0 <= t <= w1]
+            assert inside, (w0, w1, times)
 
-    outs1, rcs1 = _launch_sharded("tp_resume_w1", scratch, 1)
-    assert rcs1 == [0], outs1[0]
-    assert "RESTORED last 1 7 1" in outs1[0], outs1[0]
-    cs1 = [ln for ln in outs1[0].splitlines()
-           if ln.startswith("CHECKSUM")][0]
-    assert cs1 == checksums[0], (cs1, checksums[0])
+        checksums = []
+        outs2, rcs2 = _launch_sharded("tp_resume", scratch, 2)
+        assert rcs2 == [0, 0], "\n".join(outs2)
+        for out in outs2:
+            assert "RESTORED last 1 7 1" in out, out
+            checksums.append([ln for ln in out.splitlines()
+                              if ln.startswith("CHECKSUM")][0])
+        assert checksums[0] == checksums[1], checksums
+
+        outs1, rcs1 = _launch_sharded("tp_resume_w1", scratch, 1)
+        assert rcs1 == [0], outs1[0]
+        assert "RESTORED last 1 7 1" in outs1[0], outs1[0]
+        cs1 = [ln for ln in outs1[0].splitlines()
+               if ln.startswith("CHECKSUM")][0]
+        assert cs1 == checksums[0], (cs1, checksums[0])
+
+    # Three attempts, not two: the gloo connection race (both ranks
+    # -6, `op.preamble.length <= op.nbytes`) is the most frequent of
+    # the recorded marginals and each tp_commit round is cheap (~35s).
+    retry_marginal("tp sharded-commit-overlap drill", attempt,
+                   attempts=3)
