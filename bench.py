@@ -205,6 +205,13 @@ def measure(arch: str, size: int, per_chip_batch: int,
         "value": round(img_s_chip, 2),
         "unit": "img/s/chip",
         "tflops_per_chip": round(tflops_chip, 2),
+        # The raw analytic model-FLOP count behind tflops_per_chip /
+        # mfu_pct (utils/flops.py, the 3x-forward convention) — stamped
+        # so BENCH_*.json carries honest, recomputable MFU instead of
+        # an opaque ratio, and so the chip accountant's XLA
+        # cost-analysis figure has an analytic anchor to be checked
+        # against (benchmarks/bench_smoke.py does exactly that).
+        "model_flops_per_image": int(step_flops),
         "chip": kind,
         "compute_dtype": "bf16" if bf16 else "fp32",
         "optimizer": optimizer,

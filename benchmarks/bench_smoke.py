@@ -43,6 +43,15 @@ named regressions, and the clean twin compared against itself must
 exit 0 — the gate can both catch a real regression and stay quiet on
 identical runs.
 
+Stage 5 — chip-accountant gate (ISSUE 19): the compiled FORWARD
+executable's ``cost_analysis()`` flops must land within 10% of the
+hand-computed padding-aware analytic count
+(``utils/flops.resnet_forward_flops_padded`` — XLA's valid-tap
+convention; at 16x16 the naive roofline count overcounts ~3x because
+the deep stages run at 1x1-4x4 where most 3x3 taps are padding), and
+a real engine run's startup plan must carry the accountant's
+preflight verdict line.
+
 Prints one JSON line per stage and exits non-zero on any crash, a
 non-finite loss, or a telemetry-regression violation.
 """
@@ -352,6 +361,97 @@ def _trace_stage() -> int:
     return 1 if failures else 0
 
 
+def _chipacct_stage() -> int:
+    """Stage 5 — chip-accountant gate: (a) the forward executable's
+    ``cost_analysis()`` flops vs the padding-aware hand count, within
+    10% (the analytic side of every MFU this repo will ever report —
+    if the two diverge, one of the counters is lying); (b) a real
+    engine run's startup plan carries the preflight verdict."""
+    import contextlib
+    import io
+    import tempfile
+
+    import jax
+
+    from imagent_tpu.cluster import make_mesh
+    from imagent_tpu.config import Config
+    from imagent_tpu.models import create_model
+    from imagent_tpu.telemetry import chipacct
+    from imagent_tpu.train import (
+        create_train_state, make_eval_step, make_optimizer,
+        make_train_step, replicate_state,
+    )
+    from imagent_tpu.utils import flops as flops_lib
+
+    cfg = Config(arch="resnet18", image_size=16, num_classes=4,
+                 batch_size=4, dataset="synthetic", synthetic_size=32,
+                 workers=0, bf16=False, seed=0)
+    global_batch = cfg.batch_size * len(jax.devices())
+    mesh = make_mesh(model_parallel=1)
+    model = create_model(cfg.arch, cfg.num_classes, bf16=False)
+    opt = make_optimizer()
+    state = replicate_state(
+        create_train_state(model, jax.random.key(0), cfg.image_size,
+                           opt, batch_size=2), mesh)
+    step = make_train_step(model, opt, mesh, mean=cfg.mean, std=cfg.std)
+    eval_step = make_eval_step(model, mesh, mean=cfg.mean, std=cfg.std)
+    acct = chipacct.build_account(
+        train_step=step, eval_step=eval_step, state=state, mesh=mesh,
+        cfg=cfg, global_batch=global_batch)
+
+    failures = []
+    # (a) The forward (eval) executable vs the padding-aware analytic
+    # count. The eval step adds only elementwise/metric flops on top
+    # of conv+fc (~1% at this size), well inside the 10% gate.
+    xla_fwd = ((acct.get("eval") or {}).get("flops"))
+    analytic_fwd = flops_lib.resnet_forward_flops_padded(
+        cfg.arch, cfg.image_size, cfg.num_classes) * global_batch
+    if not xla_fwd:
+        failures.append("eval executable produced no cost_analysis "
+                        "flops — the accountant captured nothing")
+        rel = None
+    else:
+        rel = abs(xla_fwd - analytic_fwd) / analytic_fwd
+        if rel > 0.10:
+            failures.append(
+                f"cost-analysis forward flops {xla_fwd:.3e} vs "
+                f"analytic {analytic_fwd:.3e} differ by "
+                f"{rel:.1%} (> 10%) — a flop counter is lying")
+
+    # (b) A real run's startup plan carries the preflight verdict.
+    root = tempfile.mkdtemp(prefix="bench_chipacct_")
+    from imagent_tpu.engine import run
+    run_cfg = Config(arch="resnet18", image_size=16, num_classes=4,
+                     batch_size=4, epochs=1, lr=0.05,
+                     dataset="synthetic", synthetic_size=64,
+                     workers=0, bf16=False, log_every=0, seed=0,
+                     save_model=False, eval_every=2,
+                     log_dir=os.path.join(root, "tb"),
+                     ckpt_dir=os.path.join(root, "ck"))
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        run(run_cfg)
+    plan = [ln for ln in out.getvalue().splitlines()
+            if ln.startswith("chip accountant:")]
+    if not plan or "preflight" not in plan[0]:
+        failures.append(
+            "engine startup plan carries no chip-accountant "
+            f"preflight verdict (got: {plan!r})")
+
+    print(json.dumps({
+        "metric": "bench_chipacct",
+        "status": "FAIL" if failures else "PASS",
+        "xla_forward_flops": xla_fwd,
+        "analytic_forward_flops": analytic_fwd,
+        "rel_err": None if rel is None else round(rel, 4),
+        "train_step_flops": (acct.get("train") or {}).get("flops"),
+        "preflight": acct.get("verdict"),
+    }))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def main() -> int:
     rc = _input_path_stage()
     if rc:
@@ -362,7 +462,10 @@ def main() -> int:
     rc = _regress_gate_stage(ckpt_root)
     if rc:
         return rc
-    return _trace_stage()
+    rc = _trace_stage()
+    if rc:
+        return rc
+    return _chipacct_stage()
 
 
 if __name__ == "__main__":

@@ -872,7 +872,14 @@ def check_untimed_block(ctx: ModuleContext) -> Iterator[Finding]:
 _STEP_LOOP_SOURCES = {"device_prefetch", "Prefetcher"}
 _BLOCKING_FETCH_CALLS = {"numpy.asarray", "numpy.array",
                          "jax.device_get", "jax.block_until_ready"}
-_BLOCKING_FETCH_METHODS = {"item", "tolist", "block_until_ready"}
+_BLOCKING_FETCH_METHODS = {"item", "tolist", "block_until_ready",
+                           # Chip-accountant APIs (ISSUE 19): compile
+                           # analyses and allocator stats are host
+                           # syncs too — capture belongs at step-build
+                           # time (telemetry/chipacct.py), never in
+                           # the step loop.
+                           "memory_stats", "cost_analysis",
+                           "memory_analysis"}
 _LAG_SENTINEL = "_GUARD_LAG"
 
 
@@ -897,7 +904,9 @@ def _has_step_source_call(node: ast.AST, ctx: ModuleContext,
 def check_blocking_in_step_loop(ctx: ModuleContext) -> Iterator[Finding]:
     """Fires on ``np.asarray``/``np.array``/``jax.device_get``/
     ``jax.block_until_ready`` calls and ``.item()``/``.tolist()``/
-    ``.block_until_ready()`` methods inside the body of a ``for`` loop
+    ``.block_until_ready()`` — plus the chip-accountant surfaces
+    ``.memory_stats()``/``.cost_analysis()``/``.memory_analysis()``
+    (startup-capture-only APIs) — methods inside the body of a ``for`` loop
     that iterates ``device_prefetch(...)``/``Prefetcher(...)`` (or a
     name assigned from one, tracked in source order) — the engine's
     step loops.  Exemption: a statement whose subtree references

@@ -252,10 +252,14 @@ def initialize(backend: str | None = None,
                 # Shrunk all the way to one host: no distributed
                 # runtime — the gloo CPU collectives armed above would
                 # demand a distributed client at backend init, so
-                # un-arm them (single-process psums are local).
+                # un-arm them (single-process psums are local).  The
+                # flag's off value is the STRING "none" — Python None
+                # is rejected by make_cpu_client ("Unknown collectives
+                # implementation None"), which turned every shrink-to-
+                # one restart into a backend-init crash (exit 70).
                 try:
                     jax.config.update(
-                        "jax_cpu_collectives_implementation", None)
+                        "jax_cpu_collectives_implementation", "none")
                 except Exception:
                     pass
             return senv

@@ -206,6 +206,26 @@ class Config:
     # compile-event counts — refreshed at epoch boundaries (the same
     # state status.json records). 0 = off.
     metrics_port: int = 0
+    # Chip accountant (telemetry/chipacct.py): capture the compiled
+    # step's XLA cost/memory analyses once at startup, attribute the
+    # TrainState's per-device bytes by component, derive zero-step-cost
+    # MFU from the goodput partition, and run the OOM preflight (a
+    # modeled peak over the known HBM limit refuses the run with
+    # fatal-config exit 78 before step 0). Costs one extra startup
+    # compile per captured executable (AOT products don't land in the
+    # jit cache); False skips capture AND the preflight.
+    chipacct: bool = True
+    # Preflight HBM budget override, GiB per device: stands in where
+    # the backend reports no memory limit (CPU) or the operator wants
+    # a tighter envelope than the hardware's. 0 = use
+    # device.memory_stats() when available, else preflight reports
+    # "unknown-limit" and never refuses.
+    hbm_budget_gb: float = 0.0
+    # Peak bf16 TFLOP/s per chip for the MFU ratio, overriding the
+    # utils/flops.py device-kind registry — for kinds the registry
+    # does not know (new hardware, CPU test runs). 0 = registry only;
+    # unknown kinds then report achieved TFLOP/s without an MFU ratio.
+    peak_tflops: float = 0.0
 
     # ---- pod tracer (telemetry/trace.py) ----
     # Cross-host span timeline: every subsystem (engine phases,
@@ -544,6 +564,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "this port from process 0 (GET /metrics; "
                         "goodput, step percentiles, health, pod, "
                         "ckpt, SLO and compile series; 0 = off)")
+    # Chip accountant + OOM preflight.
+    p.add_argument("--no-chipacct", dest="chipacct",
+                   action="store_false", default=c.chipacct,
+                   help="skip the startup XLA cost/memory capture, "
+                        "MFU accounting and the OOM preflight "
+                        "(telemetry/chipacct.py); also the bypass "
+                        "for a preflight refusal")
+    p.add_argument("--hbm-budget-gb", type=float,
+                   default=c.hbm_budget_gb, metavar="GIB",
+                   help="per-device HBM budget for the OOM preflight "
+                        "when the backend reports no limit (or to "
+                        "tighten it); modeled peak over budget "
+                        "refuses the run with exit 78 (0 = device "
+                        "limit when known, else no refusal)")
+    p.add_argument("--peak-tflops", type=float, default=c.peak_tflops,
+                   metavar="TFLOPS",
+                   help="peak bf16 TFLOP/s per chip for the MFU "
+                        "ratio, overriding the device-kind registry "
+                        "(unknown kinds otherwise report achieved "
+                        "TFLOP/s only; 0 = registry)")
     # Pod tracer.
     p.add_argument("--trace", type=str, default=c.trace,
                    choices=["off", "phases", "steps"],

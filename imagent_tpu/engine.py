@@ -49,6 +49,7 @@ from imagent_tpu.resilience.watchdog import StepWatchdog
 from imagent_tpu.schedule import lr_for_epoch
 from imagent_tpu.status import StatusWriter
 from imagent_tpu.telemetry import TelemetrySession, parse_profile_at_step
+from imagent_tpu.telemetry import chipacct as chipacct_lib
 from imagent_tpu.telemetry import export as export_lib
 from imagent_tpu.telemetry import flightrec as flightrec_lib
 from imagent_tpu.telemetry import recompile as recompile_lib
@@ -62,6 +63,13 @@ from imagent_tpu.train import (
 )
 from imagent_tpu.utils.logging import TrainLogger
 from imagent_tpu.utils.metrics import AverageMeter
+
+# The chip account of the ACTIVE run (telemetry/chipacct.py): a
+# module-global handle so the fatal ramps in run() can enrich a
+# runtime RESOURCE_EXHAUSTED with the per-component byte table without
+# threading the account through every call — the same pattern the
+# recompile sentinel and the metrics exporter use.
+_chipacct_active: dict | None = None
 
 
 class PreemptionGuard:
@@ -1012,12 +1020,20 @@ def run(cfg: Config, stop_check=None) -> dict:
         raise
     except Exception as e:
         trace_lib.flush_active(fsync=True)
+        detail = f"{type(e).__name__}: {e}"
+        if chipacct_lib.classify_oom(e):
+            # A runtime RESOURCE_EXHAUSTED that slipped past (or ran
+            # without) the preflight: lead with the accountant's
+            # per-component byte table so it survives the flightrec
+            # detail truncation — the post-mortem starts from WHERE
+            # the bytes went, not just that they ran out.
+            detail = (chipacct_lib.oom_detail(_chipacct_active)
+                      + "; " + detail)
         flightrec_lib.flush_active(
-            "exception", exitcodes.FATAL_EXCEPTION,
-            detail=f"{type(e).__name__}: {e}")
+            "exception", exitcodes.FATAL_EXCEPTION, detail=detail)
         if pod is not None:
             pod.tombstone("exception", exitcodes.FATAL_EXCEPTION,
-                          detail=f"{type(e).__name__}: {e}")
+                          detail=detail)
         raise
     finally:
         # Final flush (a clean exit's post-boundary spans: the last
@@ -1601,6 +1617,26 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         eval_step = make_eval_step(model, mesh, state_specs,
                                    mean=cfg.mean, std=cfg.std)
 
+    # Chip accountant (telemetry/chipacct.py): one AOT lower+compile
+    # per executable captures XLA's cost/memory analyses and the
+    # sharding-aware state byte attribution BEFORE step 0 — then the
+    # OOM preflight refuses a modeled peak over the HBM limit while it
+    # is still a config error (fatal-config, exit 78) instead of a
+    # mid-epoch RESOURCE_EXHAUSTED. The AOT products do not land in
+    # the jit cache, so capture costs one extra startup compile per
+    # executable (recorded as capture_s; --no-chipacct skips it all).
+    global _chipacct_active
+    chip_acct = None
+    _chipacct_active = None
+    if cfg.chipacct:
+        chip_acct = chipacct_lib.build_account(
+            train_step=train_step, eval_step=eval_step, state=state,
+            mesh=mesh, cfg=cfg, global_batch=global_batch)
+        _chipacct_active = chip_acct
+        if is_master:
+            print(chipacct_lib.plan_line(chip_acct), flush=True)
+        chipacct_lib.check_preflight(chip_acct)
+
     def _resume_point(meta: dict) -> tuple[int, int, float, float, int]:
         """(start_epoch, resume_step, best_top1, best_top5, best_epoch)
         from checkpoint meta, validating a mid-epoch checkpoint's
@@ -1835,6 +1871,10 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
     # preemption stop is any-reduced).
     telem = TelemetrySession(cfg, is_master, logger)
     telem.health = monitor
+    # The static chip account: epoch_end derives the per-epoch MFU /
+    # TFLOP-per-chip sub-record from it plus the goodput partition it
+    # already measured — zero added step-loop cost.
+    telem.chipacct = chip_acct
     if monitor is not None:
 
         def _on_anomaly(a: dict) -> None:
@@ -1984,6 +2024,7 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
     last_input_alert = [None]  # newest epoch's input-wait alert (if any)
     last_clock_skew = [None]   # newest epoch's max pod wall-clock skew
     last_slo = [None]          # newest SLO session status (if armed)
+    last_acct = [None]         # newest epoch's chipacct sub-record
 
     def _end_telemetry_epoch(ep: int, tm: dict,
                              interrupted: bool = False,
@@ -2020,6 +2061,8 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         telem.gauge("world_size", float(jax.process_count()))
         telem.gauge("groups", float(n_groups))
         record = telem.epoch_end(ep, tm, interrupted=interrupted)
+        if (record or {}).get("chipacct") is not None:
+            last_acct[0] = record["chipacct"]
         last_input_alert[0] = (record or {}).get("input_wait_alert")
         last_clock_skew[0] = ((record or {}).get("clock")
                               or {}).get("max_skew_s")
@@ -2072,6 +2115,10 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                 # The live SLO verdict (breached objectives + run
                 # totals): the status CLI renders a loud line from it.
                 "slo": last_slo[0],
+                # The chip accountant's epoch verdict (MFU, modeled
+                # peak, per-component state bytes): the status CLI
+                # renders the memory table from it.
+                "chipacct": last_acct[0],
             })
         if exporter is not None and record is not None:
             # Refresh the serving snapshot: the exporter's thread
@@ -2575,6 +2622,9 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
             # A run that FINISHED in breach must say so on its last
             # status surface, not only in the event log.
             "slo": last_slo[0],
+            # The last epoch's chip account (MFU + memory table):
+            # the terminal surface keeps the efficiency verdict too.
+            "chipacct": last_acct[0],
         })
     summary = {"best_top1": best_top1, "best_top5": best_top5,
                "best_epoch": best_epoch, "total_minutes": total_min,

@@ -343,6 +343,35 @@ def render(run_dir: str, now: float | None = None,
                 f"hbm: {_fmt(hbm.get('peak_bytes_in_use', 0) / 1e9, '.2f')}"
                 f" GB peak"
                 + (f" / {_fmt(limit / 1e9, '.2f')} GB" if limit else ""))
+    # Chip accountant (telemetry/chipacct.py): MFU line + the
+    # per-component memory table. The sub-record rides both the epoch
+    # record and status.json's boundary write; prefer the epoch record
+    # (same numbers, survives a missing status.json).
+    acct = ((epoch_rec or {}).get("chipacct")
+            or (st.get("chipacct") if st else None))
+    if isinstance(acct, dict):
+        if acct.get("mfu") is not None:
+            line = f"mfu: {_fmt(acct.get('mfu'), '.1%')}"
+            if acct.get("tflops_per_chip") is not None:
+                line += (f" ({_fmt(acct.get('tflops_per_chip'), '.2f')}"
+                         " TFLOP/s/chip)")
+            lines.append(line)
+        elif acct.get("tflops_per_chip") is not None:
+            lines.append(
+                f"mfu: - (peak unknown; achieved "
+                f"{_fmt(acct.get('tflops_per_chip'), '.2f')} "
+                "TFLOP/s/chip)")
+        sb = acct.get("state_bytes") or {}
+        if sb:
+            comps = " | ".join(
+                f"{k} {_fmt(v / 1e6, '.1f')} MB"
+                for k, v in sb.items() if k != "total" and v)
+            lines.append(
+                "memory/device: modeled peak "
+                f"{_fmt((acct.get('modeled_peak_bytes') or 0) / 1e9, '.2f')}"
+                f" GB [{comps}]"
+                + (f" — preflight {acct.get('verdict')}"
+                   if acct.get("verdict") else ""))
     ck = describe_checkpoint(ckpt_dir if ckpt_dir is not None
                              else os.path.join(run_dir, "checkpoints"))
     if ck:

@@ -96,6 +96,10 @@ class TelemetrySession:
         # engine when --health-stats is on; its EWMA snapshot rides the
         # per-epoch record and the health_anomaly events land here.
         self.health = None
+        # Static chip account (telemetry/chipacct.py), installed by
+        # the engine after step-build capture; epoch_end derives the
+        # per-epoch MFU sub-record from it + the goodput partition.
+        self.chipacct = None
 
     # ---- run lifecycle --------------------------------------------------
 
@@ -324,6 +328,17 @@ class TelemetrySession:
         }
         if self.health is not None:
             record["health"] = self.health.snapshot()
+        if self.chipacct is not None:
+            # Zero-step-cost MFU: achieved flops over the useful
+            # seconds (dispatch + step_drain) the partition above
+            # already measured, against the static account's peak.
+            # Host floats only — the step loop never pays for this.
+            from imagent_tpu.telemetry import chipacct as chipacct_mod
+            perf = chipacct_mod.epoch_perf(
+                self.chipacct, record["phases"],
+                int(pcts.get("n", 0) or 0))
+            if perf is not None:
+                record["chipacct"] = perf
         tracer = trace_mod.active()
         if tracer is not None:
             # Epoch-boundary trace flush: drains every thread's ring

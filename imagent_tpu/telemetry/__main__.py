@@ -9,7 +9,9 @@ Subcommands:
   resumed run appends, so the LAST record per epoch wins.  Runs traced
   with ``--trace`` grow a trace column set (span counts + the top-3
   span names by total busy time per epoch) so a bad goodput epoch can
-  be explained without opening Perfetto.  ``--json`` replaces the
+  be explained without opening Perfetto; runs with the chip accountant
+  on grow ``mfu``/``model_gb`` columns the same conditional way (logs
+  predating either stay byte-identical).  ``--json`` replaces the
   human table with the machine-readable per-epoch document
   (``SUMMARIZE_SCHEMA``, stable keys) so regress/CI/external tooling
   stop parsing the table.
@@ -53,6 +55,12 @@ _COLUMNS = ("epoch", "wall_s", "goodput", "input_s", "p95_ms",
 _WIDTHS = (5, 8, 7, 8, 8, 4, 6, 10, 10, 7)
 _TRACE_COLUMNS = ("spans", "drop")
 _TRACE_WIDTHS = (7, 5)
+# Chip-accountant columns (telemetry/chipacct.py): appear only when
+# some epoch record carries the chipacct sub-record — a log predating
+# the accountant (or a --no-chipacct run) renders the table
+# byte-identical to the pre-accountant format (golden-pinned).
+_ACCT_COLUMNS = ("mfu", "model_gb")
+_ACCT_WIDTHS = (6, 8)
 
 
 def _cell(v, width: int, spec: str = "") -> str:
@@ -121,10 +129,17 @@ def summarize(run_dir: str, ckpt_dir: str | None = None) -> str:
     # format (both pinned by golden tests).
     has_trace = any(isinstance(rec.get("trace"), dict)
                     for rec in by_epoch.values())
+    # Same conditional-append contract for the chip accountant: the
+    # columns exist only when some record carries the sub-record.
+    has_acct = any(isinstance(rec.get("chipacct"), dict)
+                   for rec in by_epoch.values())
     columns, widths = _COLUMNS, _WIDTHS
+    if has_acct:
+        columns = columns + _ACCT_COLUMNS
+        widths = widths + _ACCT_WIDTHS
     if has_trace:
-        columns = _COLUMNS + _TRACE_COLUMNS
-        widths = _WIDTHS + _TRACE_WIDTHS
+        columns = columns + _TRACE_COLUMNS
+        widths = widths + _TRACE_WIDTHS
     lines = []
     if run_start is not None:
         lines.append(
@@ -175,6 +190,16 @@ def summarize(run_dir: str, ckpt_dir: str | None = None) -> str:
             _cell(None if peak is None else peak / 1e9,
                   _WIDTHS[9], ".2f"),
         ]
+        acct = rec.get("chipacct") \
+            if isinstance(rec.get("chipacct"), dict) else None
+        if has_acct:
+            mfu = None if acct is None else acct.get("mfu")
+            modeled = None if acct is None \
+                else acct.get("modeled_peak_bytes")
+            cells.append(_cell(mfu, _ACCT_WIDTHS[0], ".3f"))
+            cells.append(_cell(None if modeled is None
+                               else modeled / 1e9,
+                               _ACCT_WIDTHS[1], ".2f"))
         tr = rec.get("trace") if isinstance(rec.get("trace"), dict) \
             else None
         if has_trace:

@@ -34,8 +34,10 @@ Event types:
 
 Schema note: the ``health`` sub-record, the two event types above, and
 the ``clock`` (per-rank wall/mono pairs + max pod skew, from the epoch
-allgather) and ``trace`` (pod-tracer span counts/drops + top span
-names, ``telemetry/trace.py``) sub-records are ADDITIONS (consumers
+allgather), ``trace`` (pod-tracer span counts/drops + top span
+names, ``telemetry/trace.py``) and ``chipacct`` (chip-accountant MFU /
+TFLOP-per-chip / modeled peak bytes / per-component state bytes,
+``telemetry/chipacct.py``) sub-records are ADDITIONS (consumers
 ignore unknown keys/events), not a ``SCHEMA_VERSION`` bump — a bump
 would make old readers drop every record.  ``python -m imagent_tpu.telemetry summarize <run_dir>`` is
 the offline reader for the whole log.

@@ -252,6 +252,28 @@ def render_state(state: dict | None, now: float | None = None) -> str:
         exp.family("imagent_hbm_utilization_ratio", "gauge",
                    "peak HBM in use / limit"
                    ).sample(hbm.get("utilization"))
+        acct = record.get("chipacct") or {}
+        # Chip-accountant families (telemetry/chipacct.py): absent
+        # sub-record / unknown peak -> None samples -> skipped, so a
+        # --no-chipacct run still renders a valid exposition.
+        exp.family("imagent_mfu", "gauge",
+                   "model FLOPs utilization last epoch (analytic "
+                   "flops over useful seconds, vs chip peak)"
+                   ).sample(acct.get("mfu"))
+        exp.family("imagent_tflops_per_chip", "gauge",
+                   "achieved model TFLOP/s per chip last epoch"
+                   ).sample(acct.get("tflops_per_chip"))
+        exp.family("imagent_hbm_modeled_peak_bytes", "gauge",
+                   "XLA memory_analysis modeled peak per device "
+                   "(args+output+temps+code-aliased)"
+                   ).sample(acct.get("modeled_peak_bytes"))
+        fam = exp.family("imagent_hbm_state_bytes", "gauge",
+                         "per-device TrainState resident bytes by "
+                         "component (sharding-aware)")
+        for comp, nbytes in sorted(
+                (acct.get("state_bytes") or {}).items()):
+            if comp != "total" and nbytes:
+                fam.sample(nbytes, component=comp)
         exp.family("imagent_ckpt_commit_bytes", "gauge",
                    "bytes of the newest committed checkpoint "
                    "generation").sample(counters.get("ckpt_commit_bytes"))

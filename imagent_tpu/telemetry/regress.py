@@ -62,6 +62,10 @@ METRICS = (
     ("step_p99_ms", "lower_better", "median"),
     ("input_wait_frac", "lower_better", "median"),
     ("ckpt_block_s", "lower_better", "max"),
+    # Chip-accountant MFU (telemetry/chipacct.py epoch sub-record):
+    # absent on logs predating the accountant or runs without a known
+    # chip peak — an empty series simply isn't compared.
+    ("mfu", "higher_better", "median"),
 )
 
 # Environment fingerprint keys that must agree for a comparison to
@@ -123,6 +127,8 @@ def load_run(run_dir: str, warmup: int = 1) -> dict:
                 float(phases.get("input_wait", 0.0)) / wall)
         if "checkpoint" in phases:
             series["ckpt_block_s"].append(float(phases["checkpoint"]))
+        if (rec.get("chipacct") or {}).get("mfu") is not None:
+            series["mfu"].append(float(rec["chipacct"]["mfu"]))
         # Derived steady-state throughput: the p50 dispatch cadence IS
         # the per-step wall on a saturated pipeline (sampler.py), so
         # img/s/chip = global_batch / p50 / chips — comparable to the

@@ -22,8 +22,8 @@ Objective semantics:
   threshold; ``*_max`` objectives when it rises ABOVE it.
 * **Threshold objectives** (``goodput_min``, ``step_p99_ms_max``,
   ``input_wait_frac_max``, ``ckpt_block_s_max``,
-  ``hb_staleness_s_max``, ``hbm_util_max``): ``0`` DISABLES the
-  objective — the repo-wide 0-disables flag convention.
+  ``hb_staleness_s_max``, ``hbm_util_max``, ``mfu_min``): ``0``
+  DISABLES the objective — the repo-wide 0-disables flag convention.
 * **Count objectives** (``health_anomalies_max``,
   ``recompiles_max``): ``0`` is a real (strict) threshold — "any
   anomaly breaches" — so they disable with JSON ``null`` instead.
@@ -63,6 +63,7 @@ OBJECTIVES = (
     ("ckpt_block_s_max", "max", "threshold"),
     ("hb_staleness_s_max", "max", "threshold"),
     ("hbm_util_max", "max", "threshold"),
+    ("mfu_min", "min", "threshold"),
     ("health_anomalies_max", "max", "count"),
     ("recompiles_max", "max", "count"),
 )
@@ -84,6 +85,7 @@ DEFAULT_SPEC = {
         "ckpt_block_s_max": 30.0,
         "hb_staleness_s_max": 0.0,
         "hbm_util_max": 0.95,
+        "mfu_min": 0.0,
         "health_anomalies_max": 0,
         "recompiles_max": 0,
     },
@@ -178,6 +180,7 @@ def observables(record: dict) -> dict:
         "ckpt_block_s_max": phases.get("checkpoint"),
         "hb_staleness_s_max": counters.get("hb_peer_staleness_s"),
         "hbm_util_max": (record.get("hbm") or {}).get("utilization"),
+        "mfu_min": (record.get("chipacct") or {}).get("mfu"),
         "health_anomalies_max": counters.get("health_anomalies", 0.0),
         "recompiles_max": counters.get("recompiles", 0.0),
     }

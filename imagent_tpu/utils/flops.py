@@ -158,6 +158,58 @@ def forward_flops(arch: str, image_size: int,
     return resnet_forward_flops(arch, image_size, num_classes)
 
 
+def _valid_taps_1d(size: int, kernel: int, stride: int,
+                   pad: int) -> int:
+    """Sum over output positions of kernel taps that land INSIDE the
+    input (not in padding), along one spatial dim.  XLA's
+    HloCostAnalysis counts convolution FLOPs this way — 2 x real
+    multiplies only — so a hand count that wants to cross-check
+    ``cost_analysis()`` (benchmarks/bench_smoke.py stage 5) must too.
+    On large inputs the padded fraction is negligible and the naive
+    counters above stand; on a 16x16 smoke model the deep stages run
+    at 1x1-4x4 where MOST 3x3 taps are padding (~3x overcount)."""
+    out = (size + 2 * pad - kernel) // stride + 1
+    total = 0
+    for o in range(out):
+        start = o * stride - pad
+        total += max(0, min(size, start + kernel) - max(0, start))
+    return total
+
+
+def resnet_forward_flops_padded(arch: str, image_size: int,
+                                num_classes: int = 1000) -> int:
+    """Padding-aware twin of ``resnet_forward_flops``: conv FLOPs are
+    2 x valid-tap MACs (XLA's convention), so the result is directly
+    comparable to a compiled executable's ``cost_analysis()`` flops.
+    Basic-block ResNets only (the smoke-bench cross-check model);
+    the naive counter remains the MFU convention everywhere else."""
+    stages, bottleneck, _groups, _base_width = ARCH_DEFS[arch]
+    if bottleneck:
+        raise ValueError("padding-aware count implemented for "
+                         "basic-block ResNets only")
+    flops = 0
+    t = _valid_taps_1d(image_size, 7, 2, 3)
+    flops += 2 * 3 * 64 * t * t
+    h = _conv_out(image_size, 7, 2, 3)
+    h = _conv_out(h, 3, 2, 1)
+    cin = 64
+    for i, block_count in enumerate(stages):
+        f = 64 * 2 ** i
+        for j in range(block_count):
+            stride = 2 if i > 0 and j == 0 else 1
+            t1 = _valid_taps_1d(h, 3, stride, 1)
+            h_out = _conv_out(h, 3, stride, 1)
+            flops += 2 * cin * f * t1 * t1
+            t2 = _valid_taps_1d(h_out, 3, 1, 1)
+            flops += 2 * f * f * t2 * t2
+            if stride != 1 or cin != f:
+                flops += 2 * cin * f * h_out * h_out  # 1x1: no pad
+            cin = f
+            h = h_out
+    flops += 2 * cin * num_classes  # fc
+    return flops
+
+
 def train_step_flops_per_image(forward_flops: int,
                                remat: bool = False) -> int:
     """Model FLOPs for one optimizer step, per image: 3x forward

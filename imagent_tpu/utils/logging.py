@@ -125,6 +125,26 @@ class TrainLogger:
             # Peak fraction of the device's HBM: the headroom gauge
             # for batch-size / remat / fused-kernel tuning.
             w.add_scalar("hbm/utilization", hbm["utilization"], epoch)
+        acct = record.get("chipacct") or {}
+        if acct.get("mfu") is not None:
+            # Model FLOPs utilization (telemetry/chipacct.py): the
+            # ROADMAP items 3/4 efficiency curve, derived at zero
+            # step cost from the goodput partition above.
+            w.add_scalar("perf/mfu", acct["mfu"], epoch)
+        if acct.get("tflops_per_chip") is not None:
+            w.add_scalar("perf/tflops_per_chip",
+                         acct["tflops_per_chip"], epoch)
+        if acct.get("modeled_peak_bytes") is not None:
+            # XLA's own compile-time memory model — pairs with the
+            # measured hbm/peak_mb series above; a widening gap means
+            # fragmentation or an unmodeled allocation.
+            w.add_scalar("hbm/modeled_peak_mb",
+                         acct["modeled_peak_bytes"] / 1e6, epoch)
+        for comp, nbytes in (acct.get("state_bytes") or {}).items():
+            if comp != "total" and nbytes:
+                # `comp` ranges over chipacct._COMPONENTS — a fixed
+                # 4-member taxonomy, so the series family is bounded.
+                w.add_scalar(f"hbm/state_{comp}_mb", nbytes / 1e6, epoch)  # jaxlint: disable=telemetry-tag-format -- tag family bounded by the fixed chipacct component taxonomy, not per-step values
         counters = record.get("counters") or {}
         health = record.get("health") or {}
         if health:

@@ -66,6 +66,10 @@ def main() -> int:
                  seed=0, save_model=True, keep_last_k=1, backend="cpu",
                  eval_every=2, trace="phases", slo="default",
                  metrics_port=metrics_port,
+                 # A declared peak so the chip accountant can form an
+                 # MFU ratio on CPU (device kind "cpu" is honestly
+                 # absent from the peak registry).
+                 peak_tflops=1.0,
                  log_dir=os.path.join(scratch, "tb"),
                  ckpt_dir=os.path.join(scratch, "ck"))
     result = run(cfg)

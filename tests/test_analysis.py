@@ -685,6 +685,44 @@ def test_step_loop_lagged_read_and_plain_loops_silent(tmp_path):
                     rule="blocking-call-in-step-loop") == []
 
 
+STEP_LOOP_ACCOUNTANT_BAD = """
+from imagent_tpu.data.prefetch import device_prefetch
+
+def train_epoch(mesh, step, state, batches, dev, compiled, log):
+    for images, labels in device_prefetch(mesh, batches):
+        state, metrics = step(state, images, labels)
+        log(dev.memory_stats())
+        log(compiled.cost_analysis())
+        log(compiled.memory_analysis())
+    return state
+"""
+
+
+def test_step_loop_accountant_introspection_fires(tmp_path):
+    """The ISSUE 19 no-sync contract: the chip accountant's
+    introspection calls — ``memory_stats()`` (a per-device runtime
+    sync) and ``cost_analysis()``/``memory_analysis()`` (executable
+    walks) — are blocking fetches when issued inside a prefetched
+    step loop.  Rule 9 names all three."""
+    findings = lint_src(tmp_path, STEP_LOOP_ACCOUNTANT_BAD,
+                        rule="blocking-call-in-step-loop")
+    assert len(findings) == 3, findings
+    msgs = " ".join(f.message for f in findings)
+    for name in ("memory_stats", "cost_analysis", "memory_analysis"):
+        assert name in msgs, msgs
+
+
+def test_chipacct_module_is_step_loop_clean():
+    """The accountant itself honours the contract it linted into
+    existence: a select-run of rule 9 over the real
+    ``telemetry/chipacct.py`` finds nothing — every introspection
+    call happens at build/boundary time, never in a step loop."""
+    rel = os.path.join("imagent_tpu", "telemetry", "chipacct.py")
+    findings, _, _ = lint_file(os.path.join(REPO_ROOT, rel), rel,
+                               {"blocking-call-in-step-loop"})
+    assert findings == [], [f.message for f in findings]
+
+
 # ------------------------------------------------- suppressions/baseline
 
 SUPPRESSED = """

@@ -49,6 +49,27 @@ def test_train_step_multiple():
     assert train_step_flops_per_image(100, remat=True) == 400
 
 
+def test_padded_count_converges_to_naive_at_scale():
+    """The padding-aware twin (XLA's valid-tap convention, the
+    bench-smoke stage-5 anchor): at 224 the padded fraction is small
+    so the two counters agree within a few percent; at 16 the naive
+    count overcounts ~3x (deep stages run at 1x1-4x4 feature maps
+    where most 3x3 taps land in padding); bottlenecks are out of
+    scope by explicit refusal."""
+    from imagent_tpu.utils.flops import resnet_forward_flops_padded
+    for size in (224, 16):
+        padded = resnet_forward_flops_padded("resnet18", size)
+        naive = resnet_forward_flops("resnet18", size)
+        assert padded < naive
+    assert (resnet_forward_flops_padded("resnet18", 224)
+            / resnet_forward_flops("resnet18", 224)) > 0.9
+    ratio16 = (resnet_forward_flops("resnet18", 16)
+               / resnet_forward_flops_padded("resnet18", 16))
+    assert 2.5 < ratio16 < 4.5, ratio16
+    with pytest.raises(ValueError):
+        resnet_forward_flops_padded("resnet50", 224)
+
+
 def test_chip_peak_lookup():
     assert chip_peak_bf16_tflops("TPU v5 lite") == 197.0
     assert chip_peak_bf16_tflops("TPU v4") == 275.0

@@ -476,6 +476,47 @@ def test_telemetry_summarize_golden_output(tmp_path):
     assert "no telemetry.jsonl" in empty.stdout
 
 
+def test_telemetry_summarize_chipacct_columns(tmp_path):
+    """The chip-accountant columns (ISSUE 19) appear ONLY when a
+    record carries the ``chipacct`` sub-record — the golden test
+    above pins that a pre-accountant log still renders byte-identical
+    (the addition is conditional, not a table-format bump)."""
+    events = [dict(rec) for rec in _GOLDEN_EVENTS]
+    events[1] = dict(events[1])
+    events[1]["chipacct"] = {
+        "verdict": "ok", "modeled_peak_bytes": 3.2e9,
+        "state_bytes": {"params": 1e9, "total": 1e9},
+        "peak_tflops": 275.0, "tflops_per_chip": 115.61,
+        "mfu": 0.4204}
+    events[3] = dict(events[3])
+    events[3]["chipacct"] = {
+        "verdict": "ok", "modeled_peak_bytes": 3.2e9,
+        "state_bytes": {"params": 1e9, "total": 1e9},
+        "peak_tflops": None, "tflops_per_chip": 118.0,
+        "mfu": None}  # honest-unknown peak: no ratio, cell dashes
+    with open(tmp_path / "telemetry.jsonl", "w") as f:
+        for rec in events:
+            f.write(json.dumps(rec) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "imagent_tpu.telemetry", "summarize",
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=60, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr
+    lines = proc.stdout.splitlines()
+    header = [ln for ln in lines if ln.startswith("epoch")][0]
+    assert "mfu" in header.split() and "model_gb" in header.split()
+    row1 = [ln for ln in lines if ln.strip().startswith("1 ")][0]
+    assert "0.420" in row1 and "3.20" in row1, row1
+    # --json carries the raw sub-record for scripts.
+    js = subprocess.run(
+        [sys.executable, "-m", "imagent_tpu.telemetry", "summarize",
+         str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=60, cwd=REPO_ROOT)
+    doc = json.loads(js.stdout)
+    ep0 = [e for e in doc["epochs"] if e["epoch"] == 0][0]
+    assert ep0["chipacct"]["mfu"] == 0.4204
+
+
 # -------------------------------------------------- engine round-trips
 
 def _cfg(tmp_path, **kw):

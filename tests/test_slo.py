@@ -244,7 +244,18 @@ def _full_state():
                         "peak_bytes_in_use": 2e9,
                         "bytes_limit": 16e9, "utilization": 0.125},
                 "counters": {"h2d_mb": 120.0,
-                             "ckpt_commit_bytes": 5e7}},
+                             "ckpt_commit_bytes": 5e7},
+                "chipacct": {"verdict": "ok",
+                             "modeled_peak_bytes": 3.2e9,
+                             "state_bytes": {"params": 1e9,
+                                             "opt_state": 1e9,
+                                             "ema": 0,
+                                             "batch_stats": 1e6,
+                                             "total": 2.001e9},
+                             "peak_tflops": 275.0,
+                             "model_flops_per_step": 5e12,
+                             "tflops_per_chip": 115.6,
+                             "mfu": 0.42}},
         health={"grad_norm_ewma": 1.2, "update_ratio_ewma": 1e-3,
                 "loss_ewma": 2.3, "anomalies": 4, "bad_steps": 1},
         slo={"epochs_judged": 3, "breached": ["goodput_min"],
@@ -274,6 +285,19 @@ def test_exposition_golden_and_validator_accepts():
     assert s["imagent_peer_heartbeat_staleness_seconds"][
         (("rank", "1"),)] == 2.3
     assert s["imagent_hbm_utilization_ratio"][()] == 0.125
+    # Chip-accountant families (PR 19): MFU/TFLOPs gauges plus the
+    # per-component modeled memory attribution.
+    assert s["imagent_mfu"][()] == 0.42
+    assert s["imagent_tflops_per_chip"][()] == 115.6
+    assert s["imagent_hbm_modeled_peak_bytes"][()] == 3.2e9
+    assert s["imagent_hbm_state_bytes"][
+        (("component", "params"),)] == 1e9
+    assert s["imagent_hbm_state_bytes"][
+        (("component", "batch_stats"),)] == 1e6
+    # "total" is derivable and "ema" is zero here — neither sampled.
+    comps = {dict(k)["component"]
+             for k in s["imagent_hbm_state_bytes"]}
+    assert "total" not in comps and "ema" not in comps
     assert s["imagent_slo_breached"][
         (("objective", "goodput_min"),)] == 1.0
     assert s["imagent_slo_breaches_total"][

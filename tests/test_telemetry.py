@@ -521,6 +521,17 @@ def test_pod_telemetry_two_process_engine_run(tmp_path):
     assert (("objective", "goodput_min"),) in \
         samples["imagent_slo_breached"]
     assert samples["imagent_up"][()] == 1.0
+    # Chip-accountant families (ISSUE 19) ride the same live scrape.
+    # The mfu gauge may sample None at an epoch-0 boundary (compile-
+    # dominated wall -> honest null), but its family header and the
+    # state-byte attribution (pure metadata, always known) must be
+    # present in any boundary exposition.
+    assert "# TYPE imagent_mfu gauge" in text, text[:800]
+    assert "# TYPE imagent_tflops_per_chip gauge" in text
+    sb = samples.get("imagent_hbm_state_bytes") or {}
+    assert (("component", "params"),) in sb, sorted(samples)
+    assert sb[(("component", "params"),)] > 0
+    assert samples["imagent_hbm_modeled_peak_bytes"][()] > 0
     # The SLO engine judged the run (epoch 0 exempt as warmup), its
     # standing verdict rode status.json, and the status CLI renders a
     # slo line from it; breaches (if any on this contended CPU box)
