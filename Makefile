@@ -6,7 +6,7 @@ PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider
 
 .PHONY: smoke test lint bench-smoke bench-anatomy bench-input \
 	drill-pod drill-divergence drill-elastic drill-sharded drill-tp \
-	trace-smoke slo-check slo-smoke
+	drill-warmstart trace-smoke slo-check slo-smoke
 
 # Static-analysis gate (docs/STATIC_ANALYSIS.md): ONE command runs
 # both layers — jaxlint (per-module JAX/TPU rules) and podlint (the
@@ -86,6 +86,19 @@ drill-elastic:
 # with no sample replayed or skipped. All tier-1.
 drill-tp:
 	$(PYTEST) -m "not slow" tests/test_groups.py tests/test_tp_pod.py
+
+# Warm-start resize drill (docs/OPERATIONS.md "Warm starts and the
+# compile cache" — ISSUE 20's done bar): three fresh engine
+# processes sharing one --compile-cache dir — cold populate, then a
+# requeue/--resume restart and a replay, both of which must load
+# every step executable from the persistent AOT store (2 hits, 0
+# compiled, 0 fallback dispatches), wash the restored state before
+# the first donated dispatch, and land startup at a fraction of the
+# cold compile. Prints cold-vs-warm startup and process-wall JSON
+# lines; paste the summary numbers into docs/OPERATIONS.md when the
+# hardware or jax pin changes.
+drill-warmstart:
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/warmstart.py
 
 # Sharded-state resilience suite (docs/OPERATIONS.md "Sharded
 # checkpoints and salvage coverage" — ROADMAP item 2's done bar): the

@@ -52,6 +52,17 @@ the deep stages run at 1x1-4x4 where most 3x3 taps are padding), and
 a real engine run's startup plan must carry the accountant's
 preflight verdict line.
 
+Stage 6 — warm-start gate (ISSUE 20): two engine runs in FRESH
+subprocesses sharing one ``--compile-cache`` dir. The cold run must
+compile and serialize both step executables (0 hits / 2 compiled /
+2 saved); the warm resumed run must load them (2 hits / 0 compiled),
+dispatch every step on the loaded executables (0 fallbacks), wash the
+restored state before the first dispatch (the jax<0.5 donated-
+deserialized-executable fence, ``compilecache.wash_state``), and land
+its startup (load+compile) phase under 30% of the cold startup —
+the sub-deadline-resize number ``make drill-warmstart`` measures at
+larger scale.
+
 Prints one JSON line per stage and exits non-zero on any crash, a
 non-finite loss, or a telemetry-regression violation.
 """
@@ -452,6 +463,90 @@ def _chipacct_stage() -> int:
     return 1 if failures else 0
 
 
+_WARM_CHILD = r"""
+import os, sys
+from imagent_tpu.config import Config
+from imagent_tpu.engine import run
+
+root, phase = sys.argv[1], sys.argv[2]
+cfg = Config(arch="resnet18", image_size=16, num_classes=4,
+             batch_size=4, epochs=(1 if phase == "cold" else 2),
+             lr=0.05, dataset="synthetic", synthetic_size=128,
+             workers=0, bf16=False, log_every=0, seed=0,
+             save_model=True, resume=(phase == "warm"),
+             log_dir=os.path.join(root, "tb"),
+             ckpt_dir=os.path.join(root, "ck"),
+             compile_cache=os.path.join(root, "cc"))
+result = run(cfg)
+sys.exit(0 if result["best_epoch"] >= 0 else 1)
+"""
+
+
+def _warm_start_stage() -> int:
+    """Stage 6 — warm-start gate: fresh processes so the serialized
+    store (not jax's in-memory caches) is what makes the second run
+    fast; resume so the restored-state wash path is exercised."""
+    import subprocess
+    import tempfile
+
+    from imagent_tpu.telemetry import read_events
+
+    root = tempfile.mkdtemp(prefix="bench_warm_")
+    for phase in ("cold", "warm"):
+        proc = subprocess.run(
+            [sys.executable, "-c", _WARM_CHILD, root, phase],
+            capture_output=True, text=True, timeout=900,
+            env=dict(os.environ))
+        if proc.returncode != 0:
+            print(f"FAIL: {phase} engine run rc={proc.returncode}: "
+                  f"{(proc.stdout + proc.stderr)[-800:]}",
+                  file=sys.stderr)
+            return 1
+
+    stamps = [r["compile_cache"] for r in read_events(
+        os.path.join(root, "tb", "telemetry.jsonl"))
+        if r.get("event") == "run_start"
+        and isinstance(r.get("compile_cache"), dict)]
+    failures = []
+    if len(stamps) != 2:
+        failures.append(f"expected 2 run_start compile_cache stamps, "
+                        f"got {len(stamps)}")
+        cold = warm = {}
+    else:
+        cold, warm = stamps
+        if (cold["hits"], cold["misses"], cold["saved"]) != (0, 2, 2):
+            failures.append(f"cold run counters off: {cold}")
+        if (warm["hits"], warm["misses"]) != (2, 0):
+            failures.append(
+                f"warm run did not load both executables: {warm}")
+        if warm.get("fallback_steps"):
+            failures.append(
+                f"{warm['fallback_steps']} warm dispatches fell back "
+                "to the jitted twin — the loaded executables were "
+                "not reused")
+        if not warm.get("washes"):
+            failures.append("warm resumed run recorded no state wash "
+                            "— the restored state reached a loaded "
+                            "donated executable unwashed")
+        if warm["startup_s"] >= 0.30 * cold["startup_s"]:
+            failures.append(
+                f"warm startup {warm['startup_s']}s is not < 30% of "
+                f"cold {cold['startup_s']}s — the store bought "
+                "nothing")
+    print(json.dumps({
+        "metric": "bench_warm_start",
+        "status": "FAIL" if failures else "PASS",
+        "cold_startup_s": cold.get("startup_s"),
+        "warm_startup_s": warm.get("startup_s"),
+        "warm_hits": warm.get("hits"),
+        "warm_fallback_steps": warm.get("fallback_steps"),
+        "warm_washes": warm.get("washes"),
+    }))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def main() -> int:
     rc = _input_path_stage()
     if rc:
@@ -465,7 +560,10 @@ def main() -> int:
     rc = _trace_stage()
     if rc:
         return rc
-    return _chipacct_stage()
+    rc = _chipacct_stage()
+    if rc:
+        return rc
+    return _warm_start_stage()
 
 
 if __name__ == "__main__":

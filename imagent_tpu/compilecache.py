@@ -1,0 +1,718 @@
+"""Warm starts: the persistent AOT executable cache (ISSUE 20).
+
+Every production restart story — elastic shrink/grow (exec-restart +
+full re-init), requeue-after-death, plain ``--resume`` — used to pay a
+from-scratch XLA compile at the worst possible moment, plus one EXTRA
+AOT compile per executable for the chip accountant's cost/memory
+capture.  This module closes both gaps:
+
+* **One-compile startup**: ``compile_steps`` lowers and compiles the
+  train/eval steps ONCE via the AOT path (``jitted.lower(*args)
+  .compile()`` — the same abstract batch the chip accountant already
+  modeled) and hands the engine dispatch wrappers around the compiled
+  executables.  The chip accountant reuses the SAME compiled objects
+  for ``cost_analysis()``/``memory_analysis()`` (``build_account``'s
+  ``compiled_train=``/``compiled_eval=`` handoff), so its
+  ``capture_s`` collapses to ~0.
+* **Persistent executable store**: where the runtime supports
+  ``jax.experimental.serialize_executable``, the compiled products are
+  serialized under ``<--compile-cache>/aot/<key>/`` keyed by a COMPLETE
+  compile fingerprint — device kind + count, mesh topology, world
+  size, jax/jaxlib versions, global batch/accum, and every config
+  field that reaches the step builders (``COMPILE_FIELDS``, pinned by
+  the completeness guard in ``tests/test_compilecache.py``).  A
+  restarted / requeued / resized-to-a-seen-topology run deserializes
+  instead of recompiling; the XLA persistent cache dir (the classic
+  ``--compile-cache`` behavior) remains the second line of defense
+  for everything else that compiles.
+* **Dispatch safety**: AOT executables are shape/dtype-specialized,
+  but the fault drills deliberately change batch geometry mid-run
+  (``step.shape_change`` crops, ``nan-grads`` promotes uint8→f32).
+  ``CompiledStep`` checks the batch signature per call (host tuple
+  compares, ~µs) and falls back to the never-yet-traced jitted twin on
+  mismatch — one counted retrace, exactly the semantics the recompile
+  sentinel drills pin.
+* **The jax<0.5 segfault fence**: the persistent XLA cache could
+  segfault on older runtimes when a cached executable was reloaded
+  (skipped since PR 1).  ``probe`` exercises the full write→reload→
+  serialize→deserialize cycle in throwaway SUBPROCESSES — a crash
+  kills the probe child, not the run — and caches the verdict in
+  ``<cache_dir>/probe.json`` keyed by (jax, jaxlib, platform).  A
+  failed probe downgrades loudly: WARN + cold compile, never a crash.
+
+``python -m imagent_tpu.compilecache ls|prune|warm <cache_dir>`` is
+the operator CLI; ``make drill-warmstart`` measures the warm-vs-cold
+restart wall time this module buys.
+
+Module import is **jax-free** (manifest: ``analysis/jaxfree.json``) —
+the CLI's ls/prune and the fingerprint math must run on any login
+node; every jax touch is lazy inside ``compile_steps``/``probe``'s
+child and the ``warm`` subcommand.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+
+# ---------------------------------------------------------------------------
+# The compile fingerprint
+# ---------------------------------------------------------------------------
+
+# Config fields that reach the step builders / model construction and
+# therefore change the compiled executable.  The completeness guard
+# (tests/test_compilecache.py::test_compile_fields_cover_step_builders)
+# diffs this list against the cfg.<field> reads in
+# engine._build_model_and_steps, so a new compile-affecting flag cannot
+# silently alias two different executables to one cache key.
+COMPILE_FIELDS = (
+    "arch", "num_classes", "image_size", "bf16", "transfer_dtype",
+    "mean", "std", "seed",
+    "optimizer", "momentum", "weight_decay",
+    "label_smoothing", "mixup", "cutmix", "color_jitter", "ema_decay",
+    "remat", "stem", "attn", "fused_mlp", "fused_qkv",
+    "register_tokens",
+    "seq_parallel", "tensor_parallel", "pipeline_parallel",
+    "microbatches", "expert_parallel", "model_parallel",
+    "moe_every", "num_experts", "capacity_factor", "moe_groups",
+    "moe_top_k", "moe_aux_weight",
+    "fsdp", "zero1", "health_stats", "check_nans",
+)
+
+# cfg fields _build_model_and_steps may read WITHOUT entering the key,
+# each with its justification (the guard asserts the set matches):
+EXEMPT_FIELDS = {
+    # Weight VALUES only — the converted tree has identical
+    # shapes/dtypes (shape agreement is enforced by the converter), so
+    # the executable is byte-identical either way.
+    "init_from_torch",
+}
+
+FINGERPRINT_VERSION = 1
+
+
+def runtime_facts() -> dict:
+    """The live-runtime half of the fingerprint (lazy jax — callers
+    hold an initialized backend)."""
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    return {
+        "jax": str(jax.__version__),
+        "jaxlib": str(getattr(jaxlib, "__version__", "?")),
+        "platform": str(dev.platform),
+        "device_kind": str(dev.device_kind),
+        "device_count": int(jax.device_count()),
+        "local_device_count": int(jax.local_device_count()),
+        "process_count": int(jax.process_count()),
+    }
+
+
+def fingerprint(cfg, *, mesh_shape: dict, global_batch: int,
+                accum: int, runtime: dict) -> dict:
+    """The complete compile fingerprint: pure data, jax-free (the
+    runtime facts are an input).  Everything that changes the lowered
+    step — topology, shapes, dtypes, versions, COMPILE_FIELDS — is in
+    here; two runs with equal fingerprints compile byte-equivalent
+    executables."""
+    fields = {}
+    for name in COMPILE_FIELDS:
+        v = getattr(cfg, name)
+        fields[name] = list(v) if isinstance(v, tuple) else v
+    return {
+        "v": FINGERPRINT_VERSION,
+        "runtime": dict(runtime),
+        "mesh": {str(k): int(v) for k, v in dict(mesh_shape).items()},
+        "global_batch": int(global_batch),
+        "accum": int(accum),
+        "cfg": fields,
+    }
+
+
+def cache_key(fp: dict) -> str:
+    """Deterministic 16-hex key over the canonical fingerprint JSON."""
+    blob = json.dumps(fp, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# The on-disk executable store
+# ---------------------------------------------------------------------------
+
+
+class ExecutableStore:
+    """``<root>/<key>/`` holds one fingerprint's executables:
+    ``fingerprint.json`` (the human-auditable key preimage) plus one
+    ``<name>.r<rank>of<world>.exe`` pickle of the
+    ``serialize_executable`` triple per (step, rank) — serialized
+    payloads carry device assignments, so a multi-host pod stores one
+    file per rank and a resized world never loads another world's
+    blob (the world size is in both the key and the file name).
+
+    Best-effort by contract: every load returns None instead of
+    raising (corrupt pickle, torn write, permission), every save is
+    atomic (tmp + rename) and reports False on failure — the cache
+    can only ever downgrade to a cold compile, never take the run
+    down."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+
+    # -- paths --------------------------------------------------------
+
+    def entry_dir(self, key: str) -> str:
+        return os.path.join(self.root, key)
+
+    def exe_path(self, key: str, name: str, rank: int,
+                 world: int) -> str:
+        return os.path.join(self.entry_dir(key),
+                            f"{name}.r{int(rank)}of{int(world)}.exe")
+
+    # -- IO -----------------------------------------------------------
+
+    def load(self, key: str, name: str, rank: int, world: int):
+        """The pickled triple, or None (absent / torn / unpicklable —
+        all of which mean 'miss', never 'crash')."""
+        path = self.exe_path(key, name, rank, world)
+        try:
+            with open(path, "rb") as f:
+                blob = pickle.load(f)
+        except Exception:  # noqa: BLE001 - any rot is a miss
+            return None
+        return blob if isinstance(blob, tuple) and len(blob) == 3 \
+            else None
+
+    def save(self, key: str, fp: dict, name: str, rank: int,
+             world: int, triple: tuple) -> bool:
+        """Atomically land one serialized executable + (once per key)
+        the fingerprint preimage. False on any failure."""
+        try:
+            d = self.entry_dir(key)
+            os.makedirs(d, exist_ok=True)
+            fp_path = os.path.join(d, "fingerprint.json")
+            if not os.path.exists(fp_path):
+                from imagent_tpu.telemetry.events import (
+                    write_json_atomic,
+                )
+                write_json_atomic(fp_path,
+                                  dict(fp, created=time.time()))
+            path = self.exe_path(key, name, rank, world)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(triple, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+            return True
+        except Exception:  # noqa: BLE001 - cache write is best-effort
+            return False
+
+    # -- maintenance (the CLI) ---------------------------------------
+
+    def entries(self) -> list[dict]:
+        """One dict per cached fingerprint: key, creation time, the
+        config headline (arch@size, mesh, world), file count, bytes."""
+        out = []
+        try:
+            keys = sorted(os.listdir(self.root))
+        except OSError:
+            return out
+        for key in keys:
+            d = self.entry_dir(key)
+            if not os.path.isdir(d):
+                continue
+            from imagent_tpu.telemetry.events import read_json
+            fp = read_json(os.path.join(d, "fingerprint.json")) or {}
+            exes = [e for e in sorted(os.listdir(d))
+                    if e.endswith(".exe")]
+            nbytes = 0
+            newest = 0.0
+            for e in exes:
+                try:
+                    st = os.stat(os.path.join(d, e))
+                    nbytes += st.st_size
+                    newest = max(newest, st.st_mtime)
+                except OSError:
+                    pass
+            cfg = fp.get("cfg") or {}
+            rt = fp.get("runtime") or {}
+            out.append({
+                "key": key,
+                "created": fp.get("created"),
+                "newest_mtime": newest or None,
+                "arch": cfg.get("arch"),
+                "image_size": cfg.get("image_size"),
+                "mesh": fp.get("mesh"),
+                "global_batch": fp.get("global_batch"),
+                "accum": fp.get("accum"),
+                "world": rt.get("process_count"),
+                "jax": rt.get("jax"),
+                "files": exes,
+                "bytes": nbytes,
+            })
+        return out
+
+    def prune(self, older_than_days: float | None = None,
+              key: str | None = None) -> list[str]:
+        """Drop entries (whole key dirs): a specific ``key``, entries
+        whose newest executable is older than ``older_than_days``, or
+        — with neither — everything. Returns the dropped keys."""
+        import shutil
+
+        dropped = []
+        cutoff = (time.time() - older_than_days * 86400.0
+                  if older_than_days is not None else None)
+        for ent in self.entries():
+            if key is not None and ent["key"] != key:
+                continue
+            if cutoff is not None and key is None:
+                newest = ent["newest_mtime"] or ent["created"] or 0.0
+                if newest >= cutoff:
+                    continue
+            shutil.rmtree(self.entry_dir(ent["key"]),
+                          ignore_errors=True)
+            dropped.append(ent["key"])
+        return dropped
+
+
+# ---------------------------------------------------------------------------
+# The capability probe (the jax<0.5 segfault fence)
+# ---------------------------------------------------------------------------
+
+PROBE_FILENAME = "probe.json"
+
+# Two child passes over one scratch cache dir.  The "write" pass
+# exercises a persistent-cache WRITE plus the serialize →
+# deserialize_and_load → execute cycle on a COLD-compiled executable
+# (the store's save/load path).  The "reload" pass then re-jits the
+# same program so XLA loads it from the disk cache and executes — the
+# exact cycle that segfaulted older CPU runtimes.  The reload pass
+# deliberately does NOT serialize: a cache-loaded executable can
+# serialize to a payload whose kernel symbols don't resolve
+# ("Symbols not found" on deserialize) — the store treats such a blob
+# as a miss at load time, so it is a non-capability, not a hazard.
+# Any crash (segfault, abort, assertion) kills the child; the parent
+# reads an exit code, never shares the fate.
+_PROBE_CHILD = r"""
+import sys
+import jax
+import jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", sys.argv[1])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+f = jax.jit(lambda x: (x * 2.0 + 1.0).sum())
+assert float(f(jnp.arange(8.0))) == 64.0
+if sys.argv[2] == "write":
+    from jax.experimental import serialize_executable as _se
+    c = jax.jit(lambda x: x * 3.0).lower(jnp.arange(4.0)).compile()
+    payload, in_tree, out_tree = _se.serialize(c)
+    c2 = _se.deserialize_and_load(payload, in_tree, out_tree)
+    assert float(c2(jnp.arange(4.0))[1]) == 3.0
+    # The engine's dispatch contract for LOADED executables with
+    # input donation: host-committed (device_put) arguments are
+    # washed through an optimization_barrier copy first (see
+    # wash_state).  Verify that cycle computes exactly — a runtime
+    # where even the washed path miscomputes must fail the probe
+    # and downgrade to cold compiles.
+    import numpy as _np
+    from jax import lax as _lax
+    g = jax.jit(lambda s, x: (s + x, (s * x).sum()),
+                donate_argnums=0)
+    cg = g.lower(jnp.zeros(8, jnp.float32),
+                 jnp.ones(8, jnp.float32)).compile()
+    pg = _se.serialize(cg)
+    del cg
+    lg = _se.deserialize_and_load(*pg)
+    wash = jax.jit(lambda t: _lax.optimization_barrier(t))
+    s0 = wash(jax.device_put(_np.arange(8.0, dtype=_np.float32)))
+    _out_s, out_v = lg(s0, jnp.ones(8, jnp.float32))
+    assert float(out_v) == 28.0, float(out_v)
+print("probe ok")
+"""
+
+# Bumped when the probe child gains new checks: a cached verdict from
+# an older probe no longer vouches for the current contract.
+PROBE_VERSION = 2
+
+
+def probe_token() -> dict:
+    """What the cached probe verdict is keyed on — a runtime change
+    (upgraded jax/jaxlib, different platform selection) re-probes."""
+    import importlib.metadata as md
+
+    def ver(pkg: str) -> str:
+        try:
+            return md.version(pkg)
+        except Exception:  # noqa: BLE001 - vendored installs
+            return "?"
+
+    return {"jax": ver("jax"), "jaxlib": ver("jaxlib"),
+            "platforms": os.environ.get("JAX_PLATFORMS", ""),
+            "probe": PROBE_VERSION}
+
+
+def probe(cache_dir: str, timeout_s: float = 180.0,
+          force: bool = False) -> tuple[bool, str]:
+    """(ok, detail) — is the persistent cache + executable
+    serialization cycle safe on this runtime?  The verdict is cached
+    in ``<cache_dir>/probe.json`` keyed by ``probe_token`` so the
+    subprocess cost (~2 trivial jax startups) is paid once per cache
+    dir per runtime, not per engine start."""
+    from imagent_tpu.telemetry.events import read_json, \
+        write_json_atomic
+
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir, PROBE_FILENAME)
+    token = probe_token()
+    rec = read_json(path)
+    if not force and rec is not None and rec.get("token") == token:
+        return bool(rec.get("ok")), str(rec.get("detail", "cached"))
+    scratch = os.path.join(cache_dir, ".probe_scratch")
+    os.makedirs(scratch, exist_ok=True)
+    ok, detail = True, "write+reload+serialize cycle ok"
+    for attempt in ("write", "reload"):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_CHILD, scratch, attempt],
+                capture_output=True, text=True, timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            ok, detail = False, f"probe child timed out ({attempt})"
+            break
+        if proc.returncode != 0:
+            tail = (proc.stderr or proc.stdout or "").strip()
+            tail = tail[-300:] if tail else "no output"
+            ok = False
+            detail = (f"probe child died rc={proc.returncode} on the "
+                      f"{attempt} pass: {tail}")
+            break
+    try:
+        write_json_atomic(path, {"token": token, "ok": ok,
+                                 "detail": detail,
+                                 "t": round(time.time(), 3)})
+    except OSError:
+        pass  # unverdicted next time; the answer stands for this run
+    return ok, detail
+
+
+# ---------------------------------------------------------------------------
+# The dispatch wrapper
+# ---------------------------------------------------------------------------
+
+
+def batch_signature(args: tuple) -> tuple:
+    """((shape, dtype), ...) over the batch args — the per-call
+    compatibility check's expected value."""
+    return tuple((tuple(a.shape), str(a.dtype)) for a in args)
+
+
+def wash_state(state):
+    """Copy every leaf of ``state`` through a jitted
+    ``lax.optimization_barrier`` so the buffers come out as XLA
+    executable outputs.
+
+    jax<0.5 CPU: a DESERIALIZED executable with input donation
+    miscomputes — metrics read as zeros/NaN, param reads land in
+    freed or foreign memory — when the donated argument holds
+    host-committed ``device_put`` buffers, exactly what checkpoint
+    restore (``place_state`` on numpy leaves) and torch-weight
+    import produce.  The same executable is bit-exact on buffers
+    that came out of any XLA computation, and a cold-compiled
+    executable is immune either way (isolated deterministically:
+    12/12 donated+device_put trials wrong, 12/12 undonated or
+    washed trials exact).  The engine therefore washes any
+    restored/imported state before it can reach a hit-loaded
+    executable, and the probe's write pass verifies this washed
+    cycle computes exactly on a toy donated executable.
+
+    The barrier — rather than ``x + 0`` — is dtype-agnostic (bool
+    and integer leaves included) and can be neither folded away by
+    XLA nor input-forwarded by jax, so the copy is guaranteed."""
+    import jax
+    from jax import lax
+
+    return jax.jit(lambda t: lax.optimization_barrier(t))(state)
+
+
+class CompiledStep:
+    """An AOT-compiled step plus its never-yet-traced jitted twin.
+
+    The compiled executable is shape/dtype-specialized; the fault
+    drills (``step.shape_change``, ``nan-grads``) change the batch
+    geometry mid-run on purpose.  Each call compares the batch args'
+    (shape, dtype) tuples — pure host arithmetic, no device sync, no
+    jax import — and dispatches the executable on match; a mismatch
+    counts ``fallback_steps`` and runs the jitted twin, which traces
+    exactly once per new geometry (the recompile sentinel still sees
+    and classifies that compile, preserving the drill semantics).
+    The state arg is not checked: its tree/shapes are pinned by the
+    same config the cache key fingerprints."""
+
+    def __init__(self, compiled, jitted, sig: tuple, stats: dict,
+                 name: str):
+        self.compiled = compiled
+        self.jitted = jitted
+        self.sig = sig
+        self.stats = stats
+        self.name = name
+
+    def __call__(self, state, *batch):
+        if batch_signature(batch) == self.sig:
+            return self.compiled(state, *batch)
+        self.stats["fallback_steps"] += 1
+        return self.jitted(state, *batch)
+
+
+class AotSteps:
+    """``compile_steps``'s result: the dispatch wrappers, the raw
+    compiled executables (the chip accountant's reuse handoff), and
+    the mutable stats dict the telemetry surfaces snapshot."""
+
+    def __init__(self, train, eval_step, compiled: dict, stats: dict):
+        self.train = train
+        self.eval = eval_step
+        self.compiled = compiled
+        self.stats = stats
+
+
+def compile_steps(*, train_step, eval_step, state, mesh, cfg,
+                  global_batch: int, fp: dict,
+                  store: ExecutableStore | None,
+                  rank: int, world: int) -> AotSteps:
+    """One-compile startup: load-or-compile each step executable via
+    the AOT path and wrap it for dispatch.
+
+    The abstract args are exactly the chip accountant's
+    (``chipacct.abstract_batch`` + the placed state + the replicated
+    lr scalar) — the ONE geometry the steady step loop dispatches, so
+    the wrapper's signature check passes on every non-drill step.
+    Serialization failures downgrade (counted, WARNed by the caller's
+    plan line) — a cold compile is the floor, never an error."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from imagent_tpu.telemetry import chipacct as chipacct_lib
+
+    key = cache_key(fp)
+    stats = {
+        "key": key,
+        "store": store.root if store is not None else None,
+        "hits": 0, "misses": 0, "saved": 0,
+        "compile_s": 0.0, "load_s": 0.0,
+        "fallback_steps": 0, "washes": 0,
+    }
+    lr_sds = jax.ShapeDtypeStruct(
+        (), np.float32, sharding=NamedSharding(mesh, P()))
+    images, labels = chipacct_lib.abstract_batch(
+        mesh, global_batch, cfg.image_size, cfg.transfer_dtype)
+    ev = chipacct_lib.abstract_batch(
+        mesh, global_batch, cfg.image_size, cfg.transfer_dtype,
+        with_mask=True)
+    plans = [("train", train_step, (state, images, labels, lr_sds))]
+    if eval_step is not None:
+        plans.append(("eval", eval_step, (state, *ev)))
+
+    try:
+        from jax.experimental import serialize_executable as serexe
+    except Exception:  # noqa: BLE001 - runtimes without the API
+        serexe = None
+
+    wrappers: dict = {"train": None, "eval": None}
+    compiled_objs: dict = {"train": None, "eval": None}
+    for name, jitted, args in plans:
+        compiled = None
+        if store is not None and serexe is not None:
+            triple = store.load(key, name, rank, world)
+            if triple is not None:
+                t0 = time.perf_counter()
+                try:
+                    compiled = serexe.deserialize_and_load(*triple)
+                except Exception:  # noqa: BLE001 - stale blob = miss
+                    compiled = None
+                if compiled is not None:
+                    stats["hits"] += 1
+                    stats["load_s"] += time.perf_counter() - t0
+        if compiled is None:
+            stats["misses"] += 1
+            t0 = time.perf_counter()
+            compiled = jitted.lower(*args).compile()
+            stats["compile_s"] += time.perf_counter() - t0
+            if store is not None and serexe is not None:
+                try:
+                    triple = serexe.serialize(compiled)
+                    if store.save(key, fp, name, rank, world, triple):
+                        stats["saved"] += 1
+                except Exception:  # noqa: BLE001 - save is best-effort
+                    pass
+        wrappers[name] = CompiledStep(
+            compiled, jitted, batch_signature(args[1:]), stats, name)
+        compiled_objs[name] = compiled
+    stats["startup_s"] = round(stats["compile_s"] + stats["load_s"], 3)
+    stats["compile_s"] = round(stats["compile_s"], 3)
+    stats["load_s"] = round(stats["load_s"], 3)
+    return AotSteps(wrappers["train"], wrappers["eval"],
+                    compiled_objs, stats)
+
+
+def plan_line(stats: dict) -> str:
+    """The startup plan print (master only) — the warm drill and
+    bench-smoke stage 6 assert the hit/miss counters appear here."""
+    src = ("serialized store + XLA disk cache" if stats.get("store")
+           else "XLA disk cache only"
+           if stats.get("xla_cache") else "in-memory only")
+    return (f"compile cache: key {stats.get('key')} — "
+            f"{stats.get('hits', 0)} hit(s), "
+            f"{stats.get('misses', 0)} compiled, "
+            f"{stats.get('saved', 0)} saved; startup "
+            f"{stats.get('startup_s', 0.0):.2f}s "
+            f"(load {stats.get('load_s', 0.0):.2f}s + compile "
+            f"{stats.get('compile_s', 0.0):.2f}s) [{src}]")
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m imagent_tpu.compilecache ls|prune|warm
+# ---------------------------------------------------------------------------
+
+
+def _fmt_mb(n: float) -> str:
+    return f"{n / 2 ** 20:.1f}MiB"
+
+
+def _cli_ls(cache_dir: str) -> int:
+    store = ExecutableStore(os.path.join(cache_dir, "aot"))
+    ents = store.entries()
+    print(f"compile cache {cache_dir}:")
+    if not ents:
+        print("  aot store: empty")
+    for e in ents:
+        mesh = e.get("mesh") or {}
+        layout = "x".join(f"{k}{v}" for k, v in sorted(mesh.items()))
+        age = ""
+        ts = e.get("newest_mtime") or e.get("created")
+        if ts:
+            age = f", {max(time.time() - float(ts), 0) / 3600.0:.1f}h old"
+        print(f"  {e['key']}: {e.get('arch')}@{e.get('image_size')} "
+              f"mesh {layout or '?'} gb {e.get('global_batch')} "
+              f"accum {e.get('accum')} world {e.get('world')} "
+              f"jax {e.get('jax')} — {len(e['files'])} exe(s), "
+              f"{_fmt_mb(e['bytes'])}{age}")
+    # The XLA persistent-cache half (everything else that compiled).
+    n, nbytes = 0, 0
+    try:
+        for ent in os.listdir(cache_dir):
+            p = os.path.join(cache_dir, ent)
+            if ent in ("aot", PROBE_FILENAME, ".probe_scratch") \
+                    or not os.path.isfile(p):
+                continue
+            n += 1
+            nbytes += os.stat(p).st_size
+    except OSError:
+        pass
+    print(f"  xla disk cache: {n} file(s), {_fmt_mb(nbytes)}")
+    from imagent_tpu.telemetry.events import read_json
+    rec = read_json(os.path.join(cache_dir, PROBE_FILENAME))
+    if rec is not None:
+        verdict = "ok" if rec.get("ok") else "UNSAFE (fenced)"
+        print(f"  probe: {verdict} — {rec.get('detail')} "
+              f"[jax {((rec.get('token') or {}).get('jax'))}]")
+    return 0
+
+
+def _cli_prune(cache_dir: str, older_days: float | None,
+               key: str | None) -> int:
+    store = ExecutableStore(os.path.join(cache_dir, "aot"))
+    dropped = store.prune(older_than_days=older_days, key=key)
+    for k in dropped:
+        print(f"pruned {k}")
+    print(f"pruned {len(dropped)} entr{'y' if len(dropped) == 1 else 'ies'}")
+    return 0
+
+
+def _cli_warm(cache_dir: str, engine_argv: list[str]) -> int:
+    """Pre-populate the cache for a config WITHOUT training: build the
+    mesh/model/steps exactly as the engine would (the shared
+    ``_build_model_and_steps``) and run ``compile_steps`` against the
+    store — a scheduler can warm a topology before the pod lands."""
+    from imagent_tpu.config import parse_args
+
+    cfg = parse_args(engine_argv)
+    ok, detail = probe(os.path.abspath(cache_dir))
+    if not ok:
+        print(f"warm: REFUSED — probe verdict: {detail}", flush=True)
+        return 1
+    import jax
+
+    from imagent_tpu import cluster
+    from imagent_tpu import engine as engine_lib
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.abspath(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      1.0)
+    mesh = cluster.make_mesh(cfg.model_parallel,
+                             pipeline_parallel=cfg.pipeline_parallel)
+    n_data = mesh.shape[cluster.DATA_AXIS]
+    if cfg.global_batch:
+        accum = cfg.global_batch // (cfg.batch_size * n_data)
+        global_batch = cfg.global_batch
+    else:
+        accum = cfg.grad_accum
+        global_batch = cfg.batch_size * n_data * accum
+    train_step, eval_step, state, _specs = \
+        engine_lib._build_model_and_steps(cfg, mesh, n_data, accum,
+                                          is_master=True)
+    store = ExecutableStore(os.path.join(os.path.abspath(cache_dir),
+                                         "aot"))
+    fp = fingerprint(cfg, mesh_shape=dict(mesh.shape),
+                     global_batch=global_batch, accum=accum,
+                     runtime=runtime_facts())
+    aot = compile_steps(
+        train_step=train_step, eval_step=eval_step, state=state,
+        mesh=mesh, cfg=cfg, global_batch=global_batch, fp=fp,
+        store=store, rank=jax.process_index(),
+        world=jax.process_count())
+    print(plan_line(aot.stats), flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m imagent_tpu.compilecache",
+        description="Persistent AOT executable cache: list, prune, or "
+                    "pre-warm a --compile-cache directory")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ls = sub.add_parser("ls", help="list cached executables + the XLA "
+                                   "disk-cache footprint")
+    ls.add_argument("cache_dir")
+    pr = sub.add_parser("prune", help="drop cached executables")
+    pr.add_argument("cache_dir")
+    pr.add_argument("--older-than-days", type=float, default=None,
+                    metavar="D",
+                    help="drop entries whose newest executable is "
+                         "older than D days (default: drop all)")
+    pr.add_argument("--key", default=None,
+                    help="drop exactly this fingerprint key")
+    warm = sub.add_parser(
+        "warm", help="compile + serialize a config's step executables "
+                     "into the cache without training (engine flags "
+                     "after --)")
+    warm.add_argument("cache_dir")
+    warm.add_argument("engine_args", nargs="*",
+                      help="engine flags, e.g. --arch resnet50 "
+                           "--image-size 224")
+    ns = p.parse_args(argv)
+    if ns.cmd == "ls":
+        return _cli_ls(ns.cache_dir)
+    if ns.cmd == "prune":
+        return _cli_prune(ns.cache_dir, ns.older_than_days, ns.key)
+    return _cli_warm(ns.cache_dir, list(ns.engine_args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

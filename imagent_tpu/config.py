@@ -143,6 +143,11 @@ class Config:
     # Persistent XLA compilation cache dir ("" = off): restarted/resumed
     # runs skip the first-step compile (~minutes for big models).
     compile_cache: str = ""
+    # One-compile AOT startup (compilecache.py): compile each step
+    # executable once via lower().compile(), share it with the chip
+    # accountant, and (with --compile-cache) serialize it for warm
+    # restarts. False = legacy jit-on-first-step.
+    aot_steps: bool = True
     check_nans: bool = False  # debug flag (SURVEY §5 sanitizers)
     # Asynchronous per-epoch LAST checkpointing (checkpoint.save_async):
     # the step loop blocks only for the device→host snapshot;
@@ -500,7 +505,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="flag a host whose input-wait or step p95 "
                         "exceeds this multiple of the pod median")
     p.add_argument("--compile-cache", type=str, default=c.compile_cache,
-                   help="persistent XLA compilation cache directory")
+                   help="persistent XLA compilation cache directory "
+                        "(also arms the serialized AOT executable "
+                        "store under <dir>/aot — see "
+                        "python -m imagent_tpu.compilecache)")
+    p.add_argument("--no-aot-steps", dest="aot_steps",
+                   action="store_false", default=c.aot_steps,
+                   help="disable the one-compile AOT startup path "
+                        "(step executables jit on first dispatch; "
+                        "chipacct pays its own capture compile)")
     p.add_argument("--check-nans", action="store_true", default=False)
     p.add_argument("--async-ckpt", dest="async_ckpt",
                    action="store_true", default=True,

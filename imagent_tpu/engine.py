@@ -33,6 +33,7 @@ import numpy as np
 
 from imagent_tpu import checkpoint as ckpt_lib
 from imagent_tpu import cluster
+from imagent_tpu import compilecache as compilecache_lib
 from imagent_tpu import elastic as elastic_lib
 from imagent_tpu import groups as groups_lib
 from imagent_tpu.config import Config
@@ -1185,12 +1186,256 @@ def _pod_death_exit(cfg: Config, err, pod, telem, epoch: int,
         pod.tombstone(err.reason, err.exit_code, detail=str(err))
 
 
+def _build_model_and_steps(cfg, mesh, n_data: int, accum: int,
+                           is_master: bool):
+    """Model + init + placement + step builders, extracted from the
+    body of ``_run`` so the ``compilecache warm`` CLI can construct
+    the EXACT executables a training run would — and so the cache-key
+    completeness guard (tests/test_compilecache.py) can diff this
+    function's ``cfg.<field>`` reads against
+    ``compilecache.COMPILE_FIELDS``: every config field read here
+    shapes the compiled step and must be in the fingerprint (or in
+    the justified ``EXEMPT_FIELDS``).
+
+    Returns ``(train_step, eval_step, state, state_specs)`` with the
+    state already placed on ``mesh``. Pure construction: config
+    validation (including the sp/tp/pp/ep composition rules) happened
+    in ``_run`` before the loaders were built."""
+    use_sp = cfg.seq_parallel != "none"
+    use_tp = cfg.tensor_parallel
+    use_pp = cfg.pipeline_parallel > 1
+    use_ep = cfg.expert_parallel
+    if ((cfg.fused_qkv or cfg.register_tokens)
+            and not cfg.arch.startswith("vit")):
+        raise ValueError("--fused-qkv / --register-tokens apply to the "
+                         "ViT family only")
+    # ViT perf levers ride every ViT construction site (model and init
+    # twin alike — register tokens add params, so the trees must agree;
+    # fused_qkv keeps the tree unchanged either way).
+    vit_kw = ({"fused_qkv": cfg.fused_qkv,
+               "register_tokens": cfg.register_tokens}
+              if cfg.arch.startswith("vit") else {})
+
+    if use_sp:
+        # Optionally pipelined: layers shard over `pipe`, tokens over
+        # `model` — the ring/Ulysses collectives run inside each stage.
+        pp_kw = (dict(pipe_axis=cluster.PIPE_AXIS,
+                      microbatches=cfg.microbatches) if use_pp else {})
+        model = create_model(
+            cfg.arch, cfg.num_classes, cfg.bf16, gap_readout=True,
+            attn_impl=cfg.seq_parallel, seq_axis=cluster.MODEL_AXIS,
+            remat=cfg.remat, **pp_kw, **vit_kw)
+        # Same param tree, no mesh-axis ops — usable for host-side init.
+        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                                  gap_readout=True, remat=cfg.remat,
+                                  **({"stacked": True} if use_pp else {}),
+                                  **vit_kw)
+    elif cfg.moe_every:
+        moe_kw = dict(moe_every=cfg.moe_every, num_experts=cfg.num_experts,
+                      capacity_factor=cfg.capacity_factor,
+                      moe_groups=cfg.moe_groups, moe_top_k=cfg.moe_top_k)
+        pp_kw = (dict(pipe_axis=cluster.PIPE_AXIS,
+                      microbatches=cfg.microbatches) if use_pp else {})
+        model = create_model(
+            cfg.arch, cfg.num_classes, cfg.bf16, attn_impl=cfg.attn,
+            expert_axis=cluster.MODEL_AXIS if use_ep else None,
+            **moe_kw, **pp_kw, remat=cfg.remat, **vit_kw)
+        # Host-side init twin: same param tree; EP consumes slices of it.
+        # groups=1 — params don't depend on the capacity grouping, and
+        # the init batch (2 images) need not divide the run's groups.
+        # Under pp the twin is the layer-stacked pipe-free model.
+        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                                  attn_impl=cfg.attn,
+                                  **({"stacked": True} if use_pp else {}),
+                                  **{**moe_kw, "moe_groups": 1},
+                                  remat=cfg.remat, **vit_kw)
+    elif use_pp and not cfg.arch.startswith("vit"):
+        # ResNet family: 2-stage GPipe over heterogeneous conv stages,
+        # params replicated over pipe (parallel/resnet_pipeline.py).
+        from imagent_tpu.parallel.resnet_pipeline import PipelinedResNet
+        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                                  remat=cfg.remat, stem=cfg.stem)
+        model = PipelinedResNet(init_model, cfg.microbatches)
+    elif use_pp:
+        model = create_model(
+            cfg.arch, cfg.num_classes, cfg.bf16, attn_impl=cfg.attn,
+            pipe_axis=cluster.PIPE_AXIS, microbatches=cfg.microbatches,
+            tp_axis=cluster.MODEL_AXIS if use_tp else None,
+            remat=cfg.remat, **vit_kw)
+        # Host-side init uses the layer-stacked pipe-free twin (same
+        # param tree, parallel/pipeline.py).
+        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                                  attn_impl=cfg.attn, stacked=True,
+                                  remat=cfg.remat, **vit_kw)
+    elif use_tp and not cfg.fsdp:
+        model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                             attn_impl=cfg.attn,
+                             tp_axis=cluster.MODEL_AXIS,
+                             remat=cfg.remat, **vit_kw)
+        # Host-side init uses the unsharded twin; TP consumes slices of
+        # the same param tree (parallel/tensor_parallel.py).
+        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                                  attn_impl=cfg.attn, remat=cfg.remat,
+                                  **vit_kw)
+    elif cfg.arch.startswith("vit") and cfg.attn != "full":
+        model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                             attn_impl=cfg.attn, remat=cfg.remat,
+                             **vit_kw)
+        init_model = model
+    else:
+        if cfg.arch.startswith("vit"):
+            kw = vit_kw
+        elif cfg.arch.startswith("convnext"):
+            # stem/vit levers don't apply; drop-path is library-level
+            # (models/convnext.py docstring). --fused-mlp selects the
+            # Pallas block lowering (same param tree in every mode).
+            kw = {"fused_mlp": cfg.fused_mlp}
+            if cfg.fused_mlp != "off" and is_master:
+                from imagent_tpu.models.convnext import CONVNEXT_DEFS
+                from imagent_tpu.ops.fused_mlp import fused_mlp_plan
+                # Unknown arch: stay silent and let create_model below
+                # raise its friendly unknown-arch ValueError.
+                if cfg.arch in CONVNEXT_DEFS:
+                    cd = jnp.bfloat16 if cfg.bf16 else jnp.float32
+                    dims = CONVNEXT_DEFS[cfg.arch][1]
+                    plan = fused_mlp_plan(cfg.fused_mlp, dims, dtype=cd)
+                    # "on"-mode plan = pure VMEM fit: attributes each
+                    # unfused entry to VMEM vs the non-TPU backend.
+                    fit = fused_mlp_plan("on", dims, dtype=cd)
+
+                    def why(d):
+                        return "VMEM" if fit[d] is None else "backend"
+
+                    print("fused-mlp " + cfg.fused_mlp + ": "
+                          + ", ".join(
+                              f"C={d} " + (f"fused (rows={br})" if br
+                                           else f"unfused ({why(d)})")
+                              for d, br in plan.items()), flush=True)
+        else:
+            kw = {"stem": cfg.stem}
+        model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
+                             remat=cfg.remat, **kw)
+        init_model = model
+    if cfg.zero1 and cfg.optimizer != "sgd":
+        raise ValueError("--zero1 implements the sharded SGD update; use "
+                         "--fsdp for other optimizers")
+    optimizer = make_optimizer(cfg.momentum, cfg.weight_decay,
+                               cfg.optimizer)
+    # Same seed on every process ⇒ identical init, the DDP broadcast
+    # equivalence (imagenet.py:215,316).
+    state = create_train_state(
+        init_model, jax.random.key(cfg.seed), cfg.image_size, optimizer)
+    if cfg.init_from_torch:
+        state = _load_torch_weights(cfg, state)
+        if is_master:
+            print(f"initialized params from torch checkpoint "
+                  f"{cfg.init_from_torch}", flush=True)
+    if cfg.ema_decay > 0.0:
+        # Fresh buffers (not aliases) — the train step donates the state,
+        # and a leaf may not be donated through two tree slots at once.
+        # BN stats are averaged too (timm ModelEmaV2 buffer semantics;
+        # see TrainState docstring for the failure mode otherwise).
+        state = state.replace(
+            ema_params=jax.tree.map(jnp.array, state.params),
+            ema_batch_stats=jax.tree.map(jnp.array, state.batch_stats))
+    if cfg.zero1:
+        from imagent_tpu.parallel import zero as zero_lib
+        state = state.replace(
+            opt_state=zero_lib.init_opt_state(state.params, n_data))
+    state_specs = None
+    if cfg.fsdp and use_tp:
+        # Hybrid 2-D sharding: TP dims on `model`, FSDP on `data`, both
+        # as pure annotations on the PLAIN model — GSPMD derives the
+        # collectives (parallel/fsdp.py::fsdp_tp_param_specs).
+        from imagent_tpu.parallel.fsdp import fsdp_tp_state_specs
+        state_specs = fsdp_tp_state_specs(state, n_data)
+    elif cfg.fsdp:
+        from imagent_tpu.parallel.fsdp import fsdp_state_specs
+        state_specs = fsdp_state_specs(state, n_data)
+    elif cfg.zero1:
+        from imagent_tpu.parallel.zero import zero1_state_specs
+        state_specs = zero1_state_specs(state)
+    elif use_pp and not cfg.arch.startswith("vit"):
+        from imagent_tpu.parallel.resnet_pipeline import (
+            resnet_pp_param_specs,
+        )
+        state_specs = state_partition_specs(
+            state, resnet_pp_param_specs(state.params))
+    elif use_pp:
+        # pp (optionally composed with tp OR ep on the model axis).
+        from imagent_tpu.parallel.pipeline import vit_pp_param_specs
+        state_specs = state_partition_specs(
+            state, vit_pp_param_specs(
+                state.params,
+                tp_axis=cluster.MODEL_AXIS if use_tp else None,
+                expert_axis=cluster.MODEL_AXIS if use_ep else None))
+    elif use_ep:
+        from imagent_tpu.parallel.expert_parallel import vit_moe_param_specs
+        state_specs = state_partition_specs(
+            state, vit_moe_param_specs(state.params))
+    elif use_tp:
+        from imagent_tpu.parallel.tensor_parallel import vit_tp_param_specs
+        state_specs = state_partition_specs(
+            state, vit_tp_param_specs(state.params))
+    state = place_state(state, mesh, state_specs)
+    from imagent_tpu.ops import make_mix_fn
+    from imagent_tpu.ops.jitter import make_jitter_fn
+    mix_fn = make_mix_fn(cfg.mixup, cfg.cutmix)
+    jitter_fn = make_jitter_fn(*cfg.color_jitter)
+    if cfg.fsdp:
+        from imagent_tpu.train import (
+            make_eval_step_auto, make_train_step_auto,
+        )
+        train_step = make_train_step_auto(
+            model, optimizer, mesh, state_specs,
+            label_smoothing=cfg.label_smoothing,
+            aux_loss_weight=cfg.moe_aux_weight,
+            grad_accum=accum,
+            mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay,
+            jitter_fn=jitter_fn, mean=cfg.mean, std=cfg.std,
+            health_stats=cfg.health_stats)
+        eval_step = make_eval_step_auto(model, mesh, state_specs,
+                                        mean=cfg.mean, std=cfg.std)
+    else:
+        train_step = make_train_step(
+            model, optimizer, mesh, seq_parallel=use_sp,
+            label_smoothing=cfg.label_smoothing,
+            state_specs=state_specs, grad_accum=accum,
+            pipe_axis=cluster.PIPE_AXIS if use_pp else None,
+            expert_parallel=use_ep, aux_loss_weight=cfg.moe_aux_weight,
+            zero1=cfg.zero1, momentum=cfg.momentum,
+            weight_decay=cfg.weight_decay,
+            mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay,
+            jitter_fn=jitter_fn, mean=cfg.mean, std=cfg.std,
+            health_stats=cfg.health_stats)
+        eval_step = make_eval_step(model, mesh, state_specs,
+                                   mean=cfg.mean, std=cfg.std)
+    return train_step, eval_step, state, state_specs
+
+
 def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
          recorder=None) -> dict:
+    # The jax<0.5 persistent-cache segfault fence (compilecache.probe):
+    # the full write→reload→serialize cycle runs in throwaway
+    # subprocesses before the cache dir is armed — a runtime that
+    # would crash downgrades to cold compiles with a loud WARN instead
+    # of taking the pod down. Verdict cached per (jax, jaxlib,
+    # platform) in <dir>/probe.json, so steady-state restarts pay a
+    # file read.
+    cc_probe_ok = False
     if cfg.compile_cache:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.abspath(cfg.compile_cache))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        cc_probe_ok, probe_detail = compilecache_lib.probe(
+            os.path.abspath(cfg.compile_cache))
+        if cc_probe_ok:
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.abspath(cfg.compile_cache))
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0)
+        else:
+            print("WARNING: --compile-cache disabled for this run — "
+                  f"capability probe failed ({probe_detail}); "
+                  "compiles stay cold but the run is safe",
+                  flush=True)
     print(cluster.rank_banner(senv), flush=True)
     is_master = jax.process_index() == 0
 
@@ -1411,227 +1656,80 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         cfg, jax.process_index() // proc_group_size, n_groups,
         global_batch, skip_train=cfg.eval_only)
 
-    if ((cfg.fused_qkv or cfg.register_tokens)
-            and not cfg.arch.startswith("vit")):
-        raise ValueError("--fused-qkv / --register-tokens apply to the "
-                         "ViT family only")
-    # ViT perf levers ride every ViT construction site (model and init
-    # twin alike — register tokens add params, so the trees must agree;
-    # fused_qkv keeps the tree unchanged either way).
-    vit_kw = ({"fused_qkv": cfg.fused_qkv,
-               "register_tokens": cfg.register_tokens}
-              if cfg.arch.startswith("vit") else {})
+    train_step, eval_step, state, state_specs = _build_model_and_steps(
+        cfg, mesh, n_data, accum, is_master)
 
-    if use_sp:
-        # Optionally pipelined: layers shard over `pipe`, tokens over
-        # `model` — the ring/Ulysses collectives run inside each stage.
-        pp_kw = (dict(pipe_axis=cluster.PIPE_AXIS,
-                      microbatches=cfg.microbatches) if use_pp else {})
-        model = create_model(
-            cfg.arch, cfg.num_classes, cfg.bf16, gap_readout=True,
-            attn_impl=cfg.seq_parallel, seq_axis=cluster.MODEL_AXIS,
-            remat=cfg.remat, **pp_kw, **vit_kw)
-        # Same param tree, no mesh-axis ops — usable for host-side init.
-        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                                  gap_readout=True, remat=cfg.remat,
-                                  **({"stacked": True} if use_pp else {}),
-                                  **vit_kw)
-    elif cfg.moe_every:
-        moe_kw = dict(moe_every=cfg.moe_every, num_experts=cfg.num_experts,
-                      capacity_factor=cfg.capacity_factor,
-                      moe_groups=cfg.moe_groups, moe_top_k=cfg.moe_top_k)
-        pp_kw = (dict(pipe_axis=cluster.PIPE_AXIS,
-                      microbatches=cfg.microbatches) if use_pp else {})
-        model = create_model(
-            cfg.arch, cfg.num_classes, cfg.bf16, attn_impl=cfg.attn,
-            expert_axis=cluster.MODEL_AXIS if use_ep else None,
-            **moe_kw, **pp_kw, remat=cfg.remat, **vit_kw)
-        # Host-side init twin: same param tree; EP consumes slices of it.
-        # groups=1 — params don't depend on the capacity grouping, and
-        # the init batch (2 images) need not divide the run's groups.
-        # Under pp the twin is the layer-stacked pipe-free model.
-        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                                  attn_impl=cfg.attn,
-                                  **({"stacked": True} if use_pp else {}),
-                                  **{**moe_kw, "moe_groups": 1},
-                                  remat=cfg.remat, **vit_kw)
-    elif use_pp and not cfg.arch.startswith("vit"):
-        # ResNet family: 2-stage GPipe over heterogeneous conv stages,
-        # params replicated over pipe (parallel/resnet_pipeline.py).
-        from imagent_tpu.parallel.resnet_pipeline import PipelinedResNet
-        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                                  remat=cfg.remat, stem=cfg.stem)
-        model = PipelinedResNet(init_model, cfg.microbatches)
-    elif use_pp:
-        model = create_model(
-            cfg.arch, cfg.num_classes, cfg.bf16, attn_impl=cfg.attn,
-            pipe_axis=cluster.PIPE_AXIS, microbatches=cfg.microbatches,
-            tp_axis=cluster.MODEL_AXIS if use_tp else None,
-            remat=cfg.remat, **vit_kw)
-        # Host-side init uses the layer-stacked pipe-free twin (same
-        # param tree, parallel/pipeline.py).
-        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                                  attn_impl=cfg.attn, stacked=True,
-                                  remat=cfg.remat, **vit_kw)
-    elif use_tp and not cfg.fsdp:
-        model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                             attn_impl=cfg.attn,
-                             tp_axis=cluster.MODEL_AXIS,
-                             remat=cfg.remat, **vit_kw)
-        # Host-side init uses the unsharded twin; TP consumes slices of
-        # the same param tree (parallel/tensor_parallel.py).
-        init_model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                                  attn_impl=cfg.attn, remat=cfg.remat,
-                                  **vit_kw)
-    elif cfg.arch.startswith("vit") and cfg.attn != "full":
-        model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                             attn_impl=cfg.attn, remat=cfg.remat,
-                             **vit_kw)
-        init_model = model
-    else:
-        if cfg.arch.startswith("vit"):
-            kw = vit_kw
-        elif cfg.arch.startswith("convnext"):
-            # stem/vit levers don't apply; drop-path is library-level
-            # (models/convnext.py docstring). --fused-mlp selects the
-            # Pallas block lowering (same param tree in every mode).
-            kw = {"fused_mlp": cfg.fused_mlp}
-            if cfg.fused_mlp != "off" and is_master:
-                from imagent_tpu.models.convnext import CONVNEXT_DEFS
-                from imagent_tpu.ops.fused_mlp import fused_mlp_plan
-                # Unknown arch: stay silent and let create_model below
-                # raise its friendly unknown-arch ValueError.
-                if cfg.arch in CONVNEXT_DEFS:
-                    cd = jnp.bfloat16 if cfg.bf16 else jnp.float32
-                    dims = CONVNEXT_DEFS[cfg.arch][1]
-                    plan = fused_mlp_plan(cfg.fused_mlp, dims, dtype=cd)
-                    # "on"-mode plan = pure VMEM fit: attributes each
-                    # unfused entry to VMEM vs the non-TPU backend.
-                    fit = fused_mlp_plan("on", dims, dtype=cd)
-
-                    def why(d):
-                        return "VMEM" if fit[d] is None else "backend"
-
-                    print("fused-mlp " + cfg.fused_mlp + ": "
-                          + ", ".join(
-                              f"C={d} " + (f"fused (rows={br})" if br
-                                           else f"unfused ({why(d)})")
-                              for d, br in plan.items()), flush=True)
+    # One-compile startup (compilecache.py): lower+compile each step
+    # executable ONCE via the AOT path, dispatch through wrappers that
+    # fall back to the jitted twin only when a fault drill changes the
+    # batch geometry, and — when --compile-cache survived the probe —
+    # load/save serialized executables so restarts, requeues and
+    # already-seen elastic topologies start warm. The compiled objects
+    # are handed to the chip accountant below, killing its duplicate
+    # capture compile. Best-effort throughout: any failure WARNs and
+    # falls back to legacy jit-on-first-step (--no-aot-steps forces
+    # that path; eval_only one-shots skip it).
+    cc_stats = None
+    compiled_train = compiled_eval = None
+    if cfg.aot_steps and not cfg.eval_only:
+        cc_store = None
+        if cfg.compile_cache and cc_probe_ok:
+            cc_store = compilecache_lib.ExecutableStore(
+                os.path.join(os.path.abspath(cfg.compile_cache), "aot"))
+        try:
+            cc_fp = compilecache_lib.fingerprint(
+                cfg, mesh_shape=dict(mesh.shape),
+                global_batch=global_batch, accum=accum,
+                runtime=compilecache_lib.runtime_facts())
+            aot = compilecache_lib.compile_steps(
+                train_step=train_step, eval_step=eval_step,
+                state=state, mesh=mesh, cfg=cfg,
+                global_batch=global_batch, fp=cc_fp, store=cc_store,
+                rank=jax.process_index(), world=jax.process_count())
+        except Exception as ce:  # noqa: BLE001 - warm path, not the run
+            print(f"WARNING: AOT step compile failed "
+                  f"({type(ce).__name__}: {ce}); falling back to "
+                  "jit-on-first-step", flush=True)
         else:
-            kw = {"stem": cfg.stem}
-        model = create_model(cfg.arch, cfg.num_classes, cfg.bf16,
-                             remat=cfg.remat, **kw)
-        init_model = model
-    if cfg.zero1 and cfg.optimizer != "sgd":
-        raise ValueError("--zero1 implements the sharded SGD update; use "
-                         "--fsdp for other optimizers")
-    optimizer = make_optimizer(cfg.momentum, cfg.weight_decay,
-                               cfg.optimizer)
-    # Same seed on every process ⇒ identical init, the DDP broadcast
-    # equivalence (imagenet.py:215,316).
-    state = create_train_state(
-        init_model, jax.random.key(cfg.seed), cfg.image_size, optimizer)
-    if cfg.init_from_torch:
-        state = _load_torch_weights(cfg, state)
-        if is_master:
-            print(f"initialized params from torch checkpoint "
-                  f"{cfg.init_from_torch}", flush=True)
-    if cfg.ema_decay > 0.0:
-        # Fresh buffers (not aliases) — the train step donates the state,
-        # and a leaf may not be donated through two tree slots at once.
-        # BN stats are averaged too (timm ModelEmaV2 buffer semantics;
-        # see TrainState docstring for the failure mode otherwise).
-        state = state.replace(
-            ema_params=jax.tree.map(jnp.array, state.params),
-            ema_batch_stats=jax.tree.map(jnp.array, state.batch_stats))
-    if cfg.zero1:
-        from imagent_tpu.parallel import zero as zero_lib
-        state = state.replace(
-            opt_state=zero_lib.init_opt_state(state.params, n_data))
-    state_specs = None
-    if cfg.fsdp and use_tp:
-        # Hybrid 2-D sharding: TP dims on `model`, FSDP on `data`, both
-        # as pure annotations on the PLAIN model — GSPMD derives the
-        # collectives (parallel/fsdp.py::fsdp_tp_param_specs).
-        from imagent_tpu.parallel.fsdp import fsdp_tp_state_specs
-        state_specs = fsdp_tp_state_specs(state, n_data)
-    elif cfg.fsdp:
-        from imagent_tpu.parallel.fsdp import fsdp_state_specs
-        state_specs = fsdp_state_specs(state, n_data)
-    elif cfg.zero1:
-        from imagent_tpu.parallel.zero import zero1_state_specs
-        state_specs = zero1_state_specs(state)
-    elif use_pp and not cfg.arch.startswith("vit"):
-        from imagent_tpu.parallel.resnet_pipeline import (
-            resnet_pp_param_specs,
-        )
-        state_specs = state_partition_specs(
-            state, resnet_pp_param_specs(state.params))
-    elif use_pp:
-        # pp (optionally composed with tp OR ep on the model axis).
-        from imagent_tpu.parallel.pipeline import vit_pp_param_specs
-        state_specs = state_partition_specs(
-            state, vit_pp_param_specs(
-                state.params,
-                tp_axis=cluster.MODEL_AXIS if use_tp else None,
-                expert_axis=cluster.MODEL_AXIS if use_ep else None))
-    elif use_ep:
-        from imagent_tpu.parallel.expert_parallel import vit_moe_param_specs
-        state_specs = state_partition_specs(
-            state, vit_moe_param_specs(state.params))
-    elif use_tp:
-        from imagent_tpu.parallel.tensor_parallel import vit_tp_param_specs
-        state_specs = state_partition_specs(
-            state, vit_tp_param_specs(state.params))
-    state = place_state(state, mesh, state_specs)
-    from imagent_tpu.ops import make_mix_fn
-    from imagent_tpu.ops.jitter import make_jitter_fn
-    mix_fn = make_mix_fn(cfg.mixup, cfg.cutmix)
-    jitter_fn = make_jitter_fn(*cfg.color_jitter)
-    if cfg.fsdp:
-        from imagent_tpu.train import (
-            make_eval_step_auto, make_train_step_auto,
-        )
-        train_step = make_train_step_auto(
-            model, optimizer, mesh, state_specs,
-            label_smoothing=cfg.label_smoothing,
-            aux_loss_weight=cfg.moe_aux_weight,
-            grad_accum=accum,
-            mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay,
-            jitter_fn=jitter_fn, mean=cfg.mean, std=cfg.std,
-            health_stats=cfg.health_stats)
-        eval_step = make_eval_step_auto(model, mesh, state_specs,
-                                        mean=cfg.mean, std=cfg.std)
-    else:
-        train_step = make_train_step(
-            model, optimizer, mesh, seq_parallel=use_sp,
-            label_smoothing=cfg.label_smoothing,
-            state_specs=state_specs, grad_accum=accum,
-            pipe_axis=cluster.PIPE_AXIS if use_pp else None,
-            expert_parallel=use_ep, aux_loss_weight=cfg.moe_aux_weight,
-            zero1=cfg.zero1, momentum=cfg.momentum,
-            weight_decay=cfg.weight_decay,
-            mix_fn=mix_fn, mix_seed=cfg.seed, ema_decay=cfg.ema_decay,
-            jitter_fn=jitter_fn, mean=cfg.mean, std=cfg.std,
-            health_stats=cfg.health_stats)
-        eval_step = make_eval_step(model, mesh, state_specs,
-                                   mean=cfg.mean, std=cfg.std)
+            compiled_train = aot.compiled.get("train")
+            compiled_eval = aot.compiled.get("eval")
+            train_step, eval_step = aot.train, aot.eval
+            cc_stats = aot.stats
+            cc_stats["xla_cache"] = bool(cfg.compile_cache
+                                         and cc_probe_ok)
+            if is_master:
+                print(compilecache_lib.plan_line(cc_stats), flush=True)
 
-    # Chip accountant (telemetry/chipacct.py): one AOT lower+compile
-    # per executable captures XLA's cost/memory analyses and the
-    # sharding-aware state byte attribution BEFORE step 0 — then the
-    # OOM preflight refuses a modeled peak over the HBM limit while it
-    # is still a config error (fatal-config, exit 78) instead of a
-    # mid-epoch RESOURCE_EXHAUSTED. The AOT products do not land in
-    # the jit cache, so capture costs one extra startup compile per
-    # executable (recorded as capture_s; --no-chipacct skips it all).
+    def _wash_if_loaded(st):
+        # jax<0.5: host-committed (device_put) buffers must never
+        # reach a hit-LOADED donated executable — restored/imported
+        # states are copied through an optimization_barrier first
+        # (compilecache.wash_state has the full defect writeup).
+        if cc_stats is not None and cc_stats.get("hits"):
+            cc_stats["washes"] += 1
+            return compilecache_lib.wash_state(st)
+        return st
+
+    # The initial state can hold host-put leaves too (torch-weight
+    # import places numpy arrays); wash it before the first dispatch.
+    state = _wash_if_loaded(state)
+
+    # Chip accountant (telemetry/chipacct.py): XLA cost/memory
+    # analyses and the sharding-aware state byte attribution BEFORE
+    # step 0 — then the OOM preflight refuses a modeled peak over the
+    # HBM limit while it is still a config error (fatal-config, exit
+    # 78) instead of a mid-epoch RESOURCE_EXHAUSTED. On the default
+    # path the analyses come off the AOT executables compiled above
+    # (capture_s ~0); only with --no-aot-steps does the account pay
+    # its own capture compile (--no-chipacct skips it all).
     global _chipacct_active
     chip_acct = None
     _chipacct_active = None
     if cfg.chipacct:
         chip_acct = chipacct_lib.build_account(
             train_step=train_step, eval_step=eval_step, state=state,
-            mesh=mesh, cfg=cfg, global_batch=global_batch)
+            mesh=mesh, cfg=cfg, global_batch=global_batch,
+            compiled_train=compiled_train, compiled_eval=compiled_eval)
         _chipacct_active = chip_acct
         if is_master:
             print(chipacct_lib.plan_line(chip_acct), flush=True)
@@ -1734,7 +1832,8 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         restored = ckpt_lib.restore_resilient(cfg.ckpt_dir, state)
         if restored is not None:
             state, meta, src = restored
-            state = place_state(state, mesh, state_specs)
+            state = _wash_if_loaded(
+                place_state(state, mesh, state_specs))
             # What was restored, for the status/telemetry surfaces: an
             # emergency salvage or a sharded-format generation must be
             # visibly not a clean Orbax LAST (satellite of the
@@ -1875,6 +1974,10 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
     # TFLOP-per-chip sub-record from it plus the goodput partition it
     # already measured — zero added step-loop cost.
     telem.chipacct = chip_acct
+    # Warm-start stats ride every epoch record as the `compilecache`
+    # sub-record (the fallback_steps counter is live — a fault drill's
+    # geometry change shows up at the next boundary).
+    telem.compilecache = cc_stats
     if monitor is not None:
 
         def _on_anomaly(a: dict) -> None:
@@ -2013,6 +2116,12 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
         # whether this attempt resumed a clean LAST, a fallback rung,
         # or an emergency salvage — and in which on-disk format.
         "restored": restored_info,
+        # This attempt's warm-start verdict (compilecache.py): cache
+        # key, hit/miss counters and the startup load/compile seconds.
+        # Per-ATTEMPT by construction — every run_start carries its
+        # own — so the regress gate's startup_compile_s series reads
+        # ALL run_start records, not the folded last one.
+        "compile_cache": cc_stats,
     })
     if resized_info is not None:
         # The resize verdict of THIS attempt (restore found a
@@ -2119,6 +2228,9 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                 # peak, per-component state bytes): the status CLI
                 # renders the memory table from it.
                 "chipacct": last_acct[0],
+                # This attempt's warm-start verdict (hits/misses/
+                # startup seconds + live fallback counter).
+                "compile_cache": cc_stats,
             })
         if exporter is not None and record is not None:
             # Refresh the serving snapshot: the exporter's thread
@@ -2374,7 +2486,8 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
                     epoch += 1
                     continue
                 state, meta, src = restored
-                state = place_state(state, mesh, state_specs)
+                state = _wash_if_loaded(
+                    place_state(state, mesh, state_specs))
                 telem.phase("recovery", time.perf_counter() - t_rec)
                 # The record names the epoch that FAILED (the one whose
                 # wall time this was), not the replay target below.
@@ -2625,6 +2738,8 @@ def _run(cfg: Config, stop_check, senv, watchdog, pod=None,
             # The last epoch's chip account (MFU + memory table):
             # the terminal surface keeps the efficiency verdict too.
             "chipacct": last_acct[0],
+            # The warm-start verdict survives to the terminal surface.
+            "compile_cache": cc_stats,
         })
     summary = {"best_top1": best_top1, "best_top5": best_top5,
                "best_epoch": best_epoch, "total_minutes": total_min,

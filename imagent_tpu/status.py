@@ -372,6 +372,21 @@ def render(run_dir: str, now: float | None = None,
                 f" GB [{comps}]"
                 + (f" — preflight {acct.get('verdict')}"
                    if acct.get("verdict") else ""))
+    # Warm-start verdict (compilecache.py): same dual-source pattern —
+    # the epoch record's `compilecache` sub-record or status.json's
+    # boundary `compile_cache` stamp, whichever survives.
+    cc = ((epoch_rec or {}).get("compilecache")
+          or (st.get("compile_cache") if st else None))
+    if isinstance(cc, dict):
+        line = (f"compile cache: {int(cc.get('hits') or 0)} hit(s) / "
+                f"{int(cc.get('misses') or 0)} compiled at startup "
+                f"({_fmt(cc.get('startup_s'), '.2f')}s)")
+        if cc.get("fallback_steps"):
+            line += (f", {int(cc['fallback_steps'])} fallback "
+                     "step(s)")
+        if cc.get("key"):
+            line += f" [key {cc['key']}]"
+        lines.append(line)
     ck = describe_checkpoint(ckpt_dir if ckpt_dir is not None
                              else os.path.join(run_dir, "checkpoints"))
     if ck:

@@ -100,6 +100,11 @@ class TelemetrySession:
         # the engine after step-build capture; epoch_end derives the
         # per-epoch MFU sub-record from it + the goodput partition.
         self.chipacct = None
+        # Warm-start stats (compilecache.py), installed by the engine
+        # after the one-compile AOT startup: cache key, hit/miss/load
+        # counters plus the LIVE fallback_steps counter — epoch_end
+        # snapshots the dict so each record reflects its boundary.
+        self.compilecache = None
 
     # ---- run lifecycle --------------------------------------------------
 
@@ -339,6 +344,11 @@ class TelemetrySession:
                 int(pcts.get("n", 0) or 0))
             if perf is not None:
                 record["chipacct"] = perf
+        if self.compilecache is not None:
+            # Warm-start sub-record (an ADDITION, not a schema bump):
+            # the startup counters are static for the attempt; the
+            # fallback_steps counter is live, so snapshot per boundary.
+            record["compilecache"] = dict(self.compilecache)
         tracer = trace_mod.active()
         if tracer is not None:
             # Epoch-boundary trace flush: drains every thread's ring

@@ -132,10 +132,14 @@ def capture_executable(jitted, *args) -> tuple[dict | None, float]:
     ShapeDtypeStructs) and extract both analyses. Returns
     ``(facts, seconds)``; facts is None when the capture failed.
 
-    The AOT compile does NOT land in the jit cache, so the run pays
-    one extra startup compile per captured executable — the seconds
-    are returned so the engine can attribute them to the ``compile``
-    goodput phase (and ``--no-chipacct`` skips the whole thing)."""
+    The AOT compile does NOT land in the jit cache, so a legacy
+    caller pays one extra startup compile per captured executable —
+    the seconds are returned so the engine can attribute them to the
+    ``compile`` goodput phase (and ``--no-chipacct`` skips the whole
+    thing). The engine's default path no longer comes here: it hands
+    ``build_account`` its own AOT-compiled executables
+    (``compiled_train=``/``compiled_eval=``, compilecache.py) and the
+    account extracts the analyses for free."""
     t0 = time.perf_counter()
     try:
         compiled = jitted.lower(*args).compile()
@@ -252,11 +256,30 @@ def abstract_batch(mesh, global_batch: int, image_size: int,
     return images, labels
 
 
+def extract_facts(compiled) -> dict:
+    """Both analyses off an ALREADY-compiled executable — the
+    zero-cost half of ``capture_executable`` for the engine's AOT
+    handoff (serialized-then-loaded executables keep both APIs)."""
+    facts: dict[str, Any] = dict(extract_cost(compiled) or
+                                 {f: None for f in _EXE_FIELDS})
+    facts["memory"] = extract_memory(compiled)
+    return facts
+
+
 def build_account(*, train_step, eval_step, state, mesh, cfg,
-                  global_batch: int) -> dict:
+                  global_batch: int, compiled_train=None,
+                  compiled_eval=None) -> dict:
     """Capture everything knowable before step 0 into one JSON-safe
     account dict. Defensive throughout: a missing analysis on some
-    backend degrades the account (None fields), never the run."""
+    backend degrades the account (None fields), never the run.
+
+    ``compiled_train``/``compiled_eval``: pre-compiled executables
+    from the engine's one-compile AOT startup (compilecache.py) —
+    when provided, their analyses are read directly and the account
+    pays NO compile of its own (``capture_s`` ~0). Without them
+    (legacy callers, tests, ``--no-aot-steps``) the account compiles
+    each jitted step itself, the duplicate this handoff exists to
+    kill."""
     import numpy as np
 
     import jax
@@ -278,15 +301,25 @@ def build_account(*, train_step, eval_step, state, mesh, cfg,
     except Exception:  # noqa: BLE001 - archs without a counter
         acct["model_flops_per_step"] = None
 
-    lr_sds = jax.ShapeDtypeStruct(
-        (), np.float32, sharding=NamedSharding(mesh, P()))
-    images, labels = abstract_batch(mesh, global_batch,
-                                    cfg.image_size, cfg.transfer_dtype)
-    train_facts, t_train = capture_executable(
-        train_step, state, images, labels, lr_sds)
+    if compiled_train is not None:
+        t0 = time.perf_counter()
+        train_facts = extract_facts(compiled_train)
+        t_train = time.perf_counter() - t0
+    else:
+        lr_sds = jax.ShapeDtypeStruct(
+            (), np.float32, sharding=NamedSharding(mesh, P()))
+        images, labels = abstract_batch(
+            mesh, global_batch, cfg.image_size, cfg.transfer_dtype)
+        train_facts, t_train = capture_executable(
+            train_step, state, images, labels, lr_sds)
     acct["train"] = train_facts
     acct["capture_s"] = round(t_train, 3)
-    if eval_step is not None:
+    if compiled_eval is not None:
+        t0 = time.perf_counter()
+        acct["eval"] = extract_facts(compiled_eval)
+        acct["capture_s"] = round(
+            t_train + time.perf_counter() - t0, 3)
+    elif eval_step is not None:
         ev = abstract_batch(mesh, global_batch, cfg.image_size,
                             cfg.transfer_dtype, with_mask=True)
         eval_facts, t_eval = capture_executable(eval_step, state, *ev)
@@ -294,6 +327,7 @@ def build_account(*, train_step, eval_step, state, mesh, cfg,
         acct["capture_s"] = round(t_train + t_eval, 3)
     else:
         acct["eval"] = None
+    acct["reused_aot"] = compiled_train is not None
     acct["state_bytes"] = state_component_bytes(state)
 
     mem = (train_facts or {}).get("memory") or {}

@@ -274,6 +274,24 @@ def render_state(state: dict | None, now: float | None = None) -> str:
                 (acct.get("state_bytes") or {}).items()):
             if comp != "total" and nbytes:
                 fam.sample(nbytes, component=comp)
+        cc = record.get("compilecache") or {}
+        # Warm-start families (compilecache.py): absent sub-record
+        # (--no-aot-steps, legacy logs) -> None samples -> skipped.
+        fam = exp.family("imagent_compile_cache_executables", "gauge",
+                         "step executables at startup by source "
+                         "(hit = deserialized from the store, "
+                         "miss = compiled cold)")
+        for source, key in (("hit", "hits"), ("miss", "misses")):
+            if cc.get(key) is not None:
+                fam.sample(cc[key], source=source)
+        exp.family("imagent_compile_cache_startup_seconds", "gauge",
+                   "wall seconds this attempt spent loading + "
+                   "compiling step executables at startup"
+                   ).sample(cc.get("startup_s"))
+        exp.family("imagent_compile_cache_fallback_steps", "counter",
+                   "steps dispatched through the jitted twin because "
+                   "the batch geometry left the AOT signature "
+                   "(fault drills)").sample(cc.get("fallback_steps"))
         exp.family("imagent_ckpt_commit_bytes", "gauge",
                    "bytes of the newest committed checkpoint "
                    "generation").sample(counters.get("ckpt_commit_bytes"))

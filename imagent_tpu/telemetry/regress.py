@@ -18,9 +18,11 @@ Verdict rules:
   AND the two medians' order-statistic CI bands are disjoint in the
   worse direction — overlapping bands mean the delta is inside the
   measured noise, not a verdict.
-* **Max metrics** (checkpoint blocking seconds): worst-case numbers,
-  compared as maxima with the tolerance plus an absolute floor (a
-  0.01 s -> 0.05 s jump is noise, not a regression).
+* **Max metrics** (checkpoint blocking seconds; per-attempt warm-start
+  startup seconds from each ``run_start``'s ``compile_cache`` stamp):
+  worst-case numbers, compared as maxima with the tolerance plus a
+  per-metric absolute floor (a 0.01 s -> 0.05 s jump is noise, not a
+  regression).
 * The first epoch record of every attempt is warmup (compiles) and is
   excluded, as are interrupted epochs — override with ``--warmup 0``.
 
@@ -66,6 +68,11 @@ METRICS = (
     # absent on logs predating the accountant or runs without a known
     # chip peak — an empty series simply isn't compared.
     ("mfu", "higher_better", "median"),
+    # Warm-start startup seconds (compilecache.py): one sample per
+    # ATTEMPT (every run_start carries its own compile_cache stamp),
+    # max-aggregated — recovery time must never silently regress.
+    # Absent on logs predating the cache or --no-aot-steps runs.
+    ("startup_compile_s", "lower_better", "max"),
 )
 
 # Environment fingerprint keys that must agree for a comparison to
@@ -74,8 +81,13 @@ METRICS = (
 ENV_KEYS = ("device_kind", "device_count", "process_count", "arch",
             "image_size", "global_batch", "transfer_dtype")
 
-# Absolute floor for the max-aggregated checkpoint-blocking verdict.
-_CKPT_ABS_FLOOR_S = 0.5
+# Absolute floors for the max-aggregated verdicts: a relative jump on
+# a tiny absolute number is noise, not a regression. Per-metric — a
+# 0.01 s -> 0.05 s checkpoint stall and a 1 s -> 2.5 s CPU-test
+# startup are both inside their floors.
+_ABS_FLOOR_S = {"ckpt_block_s": 0.5, "startup_compile_s": 2.0}
+# Back-compat alias (the original single-metric floor's name).
+_CKPT_ABS_FLOOR_S = _ABS_FLOOR_S["ckpt_block_s"]
 
 
 class RegressError(Exception):
@@ -101,13 +113,24 @@ def load_run(run_dir: str, warmup: int = 1) -> dict:
     path = os.path.join(run_dir, FILENAME)
     if not os.path.isfile(path):
         raise RegressError(f"no {FILENAME} under {run_dir}")
-    folded = fold_events(read_events(path), warmup=warmup)
+    records = read_events(path)
+    folded = fold_events(records, warmup=warmup)
     run_start = folded["run_start"] or {}
     by_epoch = folded["by_epoch"]
     env = {k: run_start.get(k) for k in ENV_KEYS}
     global_batch = run_start.get("global_batch") or 0
     device_count = run_start.get("device_count") or 0
     series: dict[str, list[float]] = {m: [] for m, _d, _a in METRICS}
+    # Startup series: one sample per ATTEMPT. fold_events keeps only
+    # the LAST run_start (the resume fold), so walk the raw records —
+    # every attempt's warm-start stamp counts, which is exactly what
+    # a recovery-time gate must see.
+    for rec in records:
+        if rec.get("event") != "run_start":
+            continue
+        cc = rec.get("compile_cache")
+        if isinstance(cc, dict) and cc.get("startup_s") is not None:
+            series["startup_compile_s"].append(float(cc["startup_s"]))
     for epoch in sorted(by_epoch):
         rec = by_epoch[epoch]
         if folded["exempt"].get(epoch) or rec.get("interrupted"):
@@ -251,7 +274,8 @@ def compare(cand: dict, base: dict, tolerance_pct: float = 5.0,
                 "worse_pct": round(100.0 * worse, 2),
             }
             checked.append(finding)
-            if worse > tol and abs_delta > _CKPT_ABS_FLOOR_S:
+            if worse > tol and abs_delta > _ABS_FLOOR_S.get(metric,
+                                                            0.0):
                 regressions.append(finding)
             continue
         cand_med, base_med = median(cs), median(bs)

@@ -2,9 +2,6 @@
 init→shard→step→psum→metrics→log→checkpoint path on 8 fake devices with
 synthetic data — the BASELINE.json "CPU smoke" config, hardware-free."""
 
-import jax
-import pytest
-
 from imagent_tpu.config import Config
 from imagent_tpu.engine import run
 
@@ -105,20 +102,26 @@ def test_e2e_async_ckpt_durability(tmp_path):
     assert result["best_epoch"] >= 0
 
 
-@pytest.mark.skipif(not hasattr(jax, "shard_map"),
-                    reason="persistent XLA compilation cache segfaults on "
-                           "jax<0.5 CPU when a cached executable is "
-                           "reloaded in-process (reproduced on the seed "
-                           "code; crashes the whole pytest session)")
 def test_e2e_compile_cache(tmp_path):
-    """--compile-cache populates the persistent XLA cache and a resumed
-    run reuses it (the async-ckpt half of this test moved to
-    test_e2e_async_ckpt_durability so it runs everywhere)."""
+    """--compile-cache populates the persistent XLA cache AND the
+    serialized AOT executable store, and a resumed run reuses both.
+    Un-skipped in PR 20: the capability probe (compilecache.probe)
+    now fences the historical jax<0.5 reload segfault in a throwaway
+    subprocess at engine startup, so this path is safe wherever it
+    runs — on a runtime that would crash, the engine downgrades to
+    cold compiles instead of entering this code path at all."""
     cache = tmp_path / "xla_cache"
     cfg = _tiny_cfg(tmp_path, epochs=2, save_model=True,
                     compile_cache=str(cache))
     run(cfg)
     assert cache.is_dir() and any(cache.iterdir())  # cache written
+    # Probe verdict cached; AOT store populated (one entry dir with
+    # the fingerprint preimage + train/eval executables).
+    assert (cache / "probe.json").is_file()
+    aot_entries = [d for d in (cache / "aot").iterdir() if d.is_dir()]
+    assert len(aot_entries) == 1
+    assert (aot_entries[0] / "fingerprint.json").is_file()
+    assert any(f.suffix == ".exe" for f in aot_entries[0].iterdir())
     cfg2 = _tiny_cfg(tmp_path, epochs=3, save_model=True, resume=True,
                      compile_cache=str(cache))
     result = run(cfg2)
